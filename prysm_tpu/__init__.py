@@ -1,0 +1,23 @@
+"""prysm_tpu — a TPU-native beacon-chain consensus framework.
+
+A ground-up, JAX/XLA/Pallas-first rebuild of the capabilities of
+``phoreproject/prysm`` (a Go Ethereum-2.0-style beacon-chain client):
+
+- BLS12-381 signature verification/aggregation with a batched, vmapped
+  pairing engine (``prysm_tpu.crypto.bls``), mirroring the reference's
+  ``crypto/bls`` interface seam (blst/herumi swap -> pure/xla/pallas swap).
+- SSZ serialization and SHA-256 Merkleization (``prysm_tpu.ssz``,
+  ``prysm_tpu.crypto.hash``) mirroring ``encoding/ssz`` + ``stateutil``.
+- The deterministic phase-0 state transition (``prysm_tpu.core``),
+  mirroring ``beacon-chain/core/{transition,blocks,epoch,helpers}``.
+- Attestation pooling/aggregation with whole-slot SignatureBatch
+  accumulation (``prysm_tpu.pipeline``), mirroring
+  ``beacon-chain/operations/attestations``.
+- A thin node harness (``prysm_tpu.node``) mirroring ``beacon-chain/node``.
+
+Reference citations in docstrings use the EXPECTED PATH convention from
+SURVEY.md (the read-only reference mount was empty at survey time; paths
+are reconstructed from upstream Prysm and tagged [U]).
+"""
+
+__version__ = "0.1.0"

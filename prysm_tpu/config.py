@@ -1,0 +1,161 @@
+"""Chain configuration presets.
+
+Mirrors the reference's ``config/params/`` (``params.BeaconConfig()``,
+``UseMainnetConfig``/``UseMinimalConfig``) [U, SURVEY.md §2] — phase-0
+constants for the mainnet and minimal presets, plus feature flags
+(``config/features/`` analog) including the north-star
+``--bls-implementation`` selector.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class BeaconChainConfig:
+    # Misc
+    preset_name: str = "mainnet"
+    max_committees_per_slot: int = 64
+    target_committee_size: int = 128
+    max_validators_per_committee: int = 2048
+    min_per_epoch_churn_limit: int = 4
+    churn_limit_quotient: int = 65536
+    shuffle_round_count: int = 90
+    min_genesis_active_validator_count: int = 16384
+    min_genesis_time: int = 1606824000
+    hysteresis_quotient: int = 4
+    hysteresis_downward_multiplier: int = 1
+    hysteresis_upward_multiplier: int = 5
+    proportional_slashing_multiplier: int = 1
+
+    # Gwei values
+    min_deposit_amount: int = 10**9
+    max_effective_balance: int = 32 * 10**9
+    ejection_balance: int = 16 * 10**9
+    effective_balance_increment: int = 10**9
+
+    # Initial values
+    genesis_fork_version: bytes = b"\x00\x00\x00\x00"
+    bls_withdrawal_prefix: bytes = b"\x00"
+
+    # Time parameters
+    genesis_delay: int = 604800
+    seconds_per_slot: int = 12
+    min_attestation_inclusion_delay: int = 1
+    slots_per_epoch: int = 32
+    min_seed_lookahead: int = 1
+    max_seed_lookahead: int = 4
+    epochs_per_eth1_voting_period: int = 64
+    slots_per_historical_root: int = 8192
+    min_validator_withdrawability_delay: int = 256
+    shard_committee_period: int = 256
+    min_epochs_to_inactivity_penalty: int = 4
+
+    # State list lengths
+    epochs_per_historical_vector: int = 65536
+    epochs_per_slashings_vector: int = 8192
+    historical_roots_limit: int = 16777216
+    validator_registry_limit: int = 2**40
+
+    # Rewards and penalties
+    base_reward_factor: int = 64
+    whistleblower_reward_quotient: int = 512
+    proposer_reward_quotient: int = 8
+    inactivity_penalty_quotient: int = 2**26
+    min_slashing_penalty_quotient: int = 128
+
+    # Max operations per block
+    max_proposer_slashings: int = 16
+    max_attester_slashings: int = 2
+    max_attestations: int = 128
+    max_deposits: int = 16
+    max_voluntary_exits: int = 16
+
+    # Signature domain types (4-byte little-endian)
+    domain_beacon_proposer: bytes = b"\x00\x00\x00\x00"
+    domain_beacon_attester: bytes = b"\x01\x00\x00\x00"
+    domain_randao: bytes = b"\x02\x00\x00\x00"
+    domain_deposit: bytes = b"\x03\x00\x00\x00"
+    domain_voluntary_exit: bytes = b"\x04\x00\x00\x00"
+    domain_selection_proof: bytes = b"\x05\x00\x00\x00"
+    domain_aggregate_and_proof: bytes = b"\x06\x00\x00\x00"
+
+    # Validator
+    target_aggregators_per_committee: int = 16
+    attestation_subnet_count: int = 64
+
+    # Deposit contract
+    deposit_chain_id: int = 1
+    deposit_network_id: int = 1
+    deposit_contract_tree_depth: int = 32
+
+    def slots_per_eth1_voting_period(self) -> int:
+        return self.epochs_per_eth1_voting_period * self.slots_per_epoch
+
+
+MAINNET_CONFIG = BeaconChainConfig()
+
+MINIMAL_CONFIG = dataclasses.replace(
+    MAINNET_CONFIG,
+    preset_name="minimal",
+    max_committees_per_slot=4,
+    target_committee_size=4,
+    shuffle_round_count=10,
+    min_genesis_active_validator_count=64,
+    genesis_delay=300,
+    seconds_per_slot=6,
+    slots_per_epoch=8,
+    epochs_per_eth1_voting_period=4,
+    slots_per_historical_root=64,
+    min_validator_withdrawability_delay=256,
+    shard_committee_period=64,
+    epochs_per_historical_vector=64,
+    epochs_per_slashings_vector=64,
+    historical_roots_limit=16777216,
+    inactivity_penalty_quotient=2**25,
+    min_slashing_penalty_quotient=64,
+    proportional_slashing_multiplier=2,
+)
+
+_active_config: BeaconChainConfig = MAINNET_CONFIG
+
+
+def beacon_config() -> BeaconChainConfig:
+    """params.BeaconConfig() analog [U]."""
+    return _active_config
+
+
+def use_mainnet_config() -> None:
+    global _active_config
+    _active_config = MAINNET_CONFIG
+
+
+def use_minimal_config() -> None:
+    global _active_config
+    _active_config = MINIMAL_CONFIG
+
+
+def use_config(cfg: BeaconChainConfig) -> None:
+    global _active_config
+    _active_config = cfg
+
+
+@dataclass
+class FeatureFlags:
+    """config/features analog [U]; ``bls_implementation`` is the
+    north-star ``--bls-implementation={pure,xla,pallas}`` flag
+    (reference swaps herumi<->blst here)."""
+
+    bls_implementation: str = "pure"
+    enable_tracing: bool = False
+    slot_batch_verify: bool = True
+    extra: dict = field(default_factory=dict)
+
+
+_features = FeatureFlags()
+
+
+def features() -> FeatureFlags:
+    return _features

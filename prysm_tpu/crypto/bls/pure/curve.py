@@ -1,0 +1,97 @@
+"""Pure-Python elliptic curve ops for BLS12-381 G1/G2.
+
+Reference analog: blst's G1/G2 point arithmetic (crypto/bls L0 [U]).
+Points are affine `(x, y)` tuples of field elements or `None` for the
+point at infinity; generic over the coordinate field (Fq for G1/E1,
+Fq2 for G2/E2', Fq12 for the untwisted curve used in pairing).
+"""
+
+from __future__ import annotations
+
+from ..params import (
+    B_G1, B_G2_C0, B_G2_C1, G1_X, G1_Y, G2_X_C0, G2_X_C1, G2_Y_C0, G2_Y_C1,
+    H_G1, R,
+)
+from .fields import Fq, Fq2, Fq12
+
+B1 = Fq(B_G1)
+B2 = Fq2.from_ints(B_G2_C0, B_G2_C1)
+B12 = Fq12.from_fq(Fq(B_G1))  # untwisted curve has b = 4
+
+G1_GEN = (Fq(G1_X), Fq(G1_Y))
+G2_GEN = (
+    Fq2.from_ints(G2_X_C0, G2_X_C1),
+    Fq2.from_ints(G2_Y_C0, G2_Y_C1),
+)
+
+
+def is_on_curve(pt, b) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return y * y - x * x * x == b
+
+
+def double(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    if y.is_zero():
+        return None
+    three = type(x).one() + type(x).one() + type(x).one()
+    two = type(x).one() + type(x).one()
+    lam = (three * (x * x)) / (two * y)
+    nx = lam * lam - x - x
+    ny = lam * (x - nx) - y
+    return (nx, ny)
+
+
+def add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if y1 == y2:
+            return double(p1)
+        return None  # inverse points
+    lam = (y2 - y1) / (x2 - x1)
+    nx = lam * lam - x1 - x2
+    ny = lam * (x1 - nx) - y1
+    return (nx, ny)
+
+
+def neg(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    return (x, -y)
+
+
+def multiply(pt, n: int):
+    """Scalar multiplication by double-and-add (no reduction mod R —
+    callers clearing cofactors pass scalars larger than R on purpose)."""
+    if n < 0:
+        return neg(multiply(pt, -n))
+    result = None
+    addend = pt
+    while n:
+        if n & 1:
+            result = add(result, addend)
+        addend = double(addend)
+        n >>= 1
+    return result
+
+
+def in_g1_subgroup(pt) -> bool:
+    return is_on_curve(pt, B1) and multiply(pt, R) is None
+
+
+def in_g2_subgroup(pt) -> bool:
+    return is_on_curve(pt, B2) and multiply(pt, R) is None
+
+
+def clear_cofactor_g1(pt):
+    return multiply(pt, H_G1)

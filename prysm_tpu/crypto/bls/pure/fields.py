@@ -1,0 +1,288 @@
+"""Pure-Python BLS12-381 field tower (trusted slow reference).
+
+Plays the role of the reference's vendored blst/mcl field arithmetic
+(crypto/bls L0 [U, SURVEY.md §2.1]) but exists primarily as the golden
+model every TPU kernel is differential-tested against — the same role
+``testing/util`` deterministic fixtures + spec vectors play upstream.
+
+Tower: Fq2 = Fq[u]/(u^2+1); Fq6 = Fq2[v]/(v^3-(u+1)); Fq12 = Fq6[w]/(w^2-v).
+"""
+
+from __future__ import annotations
+
+from ..params import P
+
+
+class Fq:
+    __slots__ = ("n",)
+
+    def __init__(self, n: int):
+        self.n = n % P
+
+    def __add__(self, o): return Fq(self.n + o.n)
+    def __sub__(self, o): return Fq(self.n - o.n)
+    def __mul__(self, o): return Fq(self.n * o.n)
+    def __neg__(self): return Fq(-self.n)
+    def __eq__(self, o): return isinstance(o, Fq) and self.n == o.n
+    def __hash__(self): return hash(("Fq", self.n))
+    def __repr__(self): return f"Fq(0x{self.n:x})"
+
+    def inv(self) -> "Fq":
+        if self.n == 0:
+            raise ZeroDivisionError("inverse of zero in Fq")
+        return Fq(pow(self.n, P - 2, P))
+
+    def __truediv__(self, o): return self * o.inv()
+
+    def __pow__(self, e: int) -> "Fq":
+        return Fq(pow(self.n, e, P))
+
+    def is_zero(self) -> bool:
+        return self.n == 0
+
+    def sqrt(self):
+        """Square root via p % 4 == 3 shortcut; returns None if non-residue."""
+        cand = pow(self.n, (P + 1) // 4, P)
+        if cand * cand % P == self.n:
+            return Fq(cand)
+        return None
+
+    def sgn0(self) -> int:
+        return self.n & 1
+
+    @staticmethod
+    def zero() -> "Fq": return Fq(0)
+    @staticmethod
+    def one() -> "Fq": return Fq(1)
+
+
+class Fq2:
+    """c0 + c1*u with u^2 = -1."""
+    __slots__ = ("c0", "c1")
+
+    def __init__(self, c0: Fq, c1: Fq):
+        self.c0, self.c1 = c0, c1
+
+    @staticmethod
+    def from_ints(a: int, b: int) -> "Fq2":
+        return Fq2(Fq(a), Fq(b))
+
+    def __add__(self, o): return Fq2(self.c0 + o.c0, self.c1 + o.c1)
+    def __sub__(self, o): return Fq2(self.c0 - o.c0, self.c1 - o.c1)
+    def __neg__(self): return Fq2(-self.c0, -self.c1)
+
+    def __mul__(self, o):
+        if isinstance(o, Fq):
+            return Fq2(self.c0 * o, self.c1 * o)
+        a, b, c, d = self.c0, self.c1, o.c0, o.c1
+        return Fq2(a * c - b * d, a * d + b * c)
+
+    def __eq__(self, o):
+        return isinstance(o, Fq2) and self.c0 == o.c0 and self.c1 == o.c1
+
+    def __hash__(self): return hash(("Fq2", self.c0.n, self.c1.n))
+    def __repr__(self): return f"Fq2(0x{self.c0.n:x}, 0x{self.c1.n:x})"
+
+    def conjugate(self): return Fq2(self.c0, -self.c1)
+
+    def mul_by_nonresidue(self) -> "Fq2":
+        """Multiply by xi = 1 + u."""
+        return Fq2(self.c0 - self.c1, self.c0 + self.c1)
+
+    def inv(self) -> "Fq2":
+        d = (self.c0 * self.c0 + self.c1 * self.c1).inv()
+        return Fq2(self.c0 * d, -(self.c1 * d))
+
+    def __truediv__(self, o): return self * o.inv()
+
+    def __pow__(self, e: int) -> "Fq2":
+        if e < 0:
+            return self.inv() ** (-e)
+        result, base = Fq2.one(), self
+        while e:
+            if e & 1:
+                result = result * base
+            base = base * base
+            e >>= 1
+        return result
+
+    def is_zero(self) -> bool:
+        return self.c0.is_zero() and self.c1.is_zero()
+
+    def sqrt(self):
+        """Square root in Fq2 (p^2 % 8 == 1 general method, via the
+        p % 4 == 3 complex method)."""
+        if self.is_zero():
+            return Fq2.zero()
+        # candidate = self^((p+1)/4 applied in Fq2 terms): use generic
+        # Tonelli-style: a1 = self^((p-3)/4); x0 = a1*self; alpha = a1*x0
+        a1 = self ** ((P - 3) // 4)
+        x0 = a1 * self
+        alpha = a1 * x0
+        if alpha == Fq2(Fq(P - 1), Fq.zero()):
+            cand = Fq2(-x0.c1, x0.c0)  # i * x0
+        else:
+            b = (alpha + Fq2.one()) ** ((P - 1) // 2)
+            cand = b * x0
+        if cand * cand == self:
+            return cand
+        return None
+
+    def sgn0(self) -> int:
+        sign_0 = self.c0.n & 1
+        zero_0 = 1 if self.c0.n == 0 else 0
+        sign_1 = self.c1.n & 1
+        return sign_0 | (zero_0 & sign_1)
+
+    @staticmethod
+    def zero() -> "Fq2": return Fq2(Fq.zero(), Fq.zero())
+    @staticmethod
+    def one() -> "Fq2": return Fq2(Fq.one(), Fq.zero())
+
+
+XI = Fq2.from_ints(1, 1)  # the Fq6 nonresidue v^3 = 1 + u
+
+
+class Fq6:
+    """c0 + c1*v + c2*v^2 with v^3 = xi = 1+u."""
+    __slots__ = ("c0", "c1", "c2")
+
+    def __init__(self, c0: Fq2, c1: Fq2, c2: Fq2):
+        self.c0, self.c1, self.c2 = c0, c1, c2
+
+    def __add__(self, o): return Fq6(self.c0 + o.c0, self.c1 + o.c1, self.c2 + o.c2)
+    def __sub__(self, o): return Fq6(self.c0 - o.c0, self.c1 - o.c1, self.c2 - o.c2)
+    def __neg__(self): return Fq6(-self.c0, -self.c1, -self.c2)
+
+    def __mul__(self, o):
+        if isinstance(o, (Fq, Fq2)):
+            oo = o if isinstance(o, Fq2) else Fq2(o, Fq.zero())
+            return Fq6(self.c0 * oo, self.c1 * oo, self.c2 * oo)
+        a0, a1, a2 = self.c0, self.c1, self.c2
+        b0, b1, b2 = o.c0, o.c1, o.c2
+        t0 = a0 * b0
+        t1 = a1 * b1
+        t2 = a2 * b2
+        c0 = t0 + (a1 * b2 + a2 * b1).mul_by_nonresidue()
+        c1 = a0 * b1 + a1 * b0 + t2.mul_by_nonresidue()
+        c2 = a0 * b2 + a2 * b0 + t1
+        return Fq6(c0, c1, c2)
+
+    def __eq__(self, o):
+        return (isinstance(o, Fq6) and self.c0 == o.c0 and self.c1 == o.c1
+                and self.c2 == o.c2)
+
+    def __repr__(self):
+        return f"Fq6({self.c0!r}, {self.c1!r}, {self.c2!r})"
+
+    def mul_by_v(self) -> "Fq6":
+        return Fq6(self.c2.mul_by_nonresidue(), self.c0, self.c1)
+
+    def inv(self) -> "Fq6":
+        a0, a1, a2 = self.c0, self.c1, self.c2
+        t0 = a0 * a0 - (a1 * a2).mul_by_nonresidue()
+        t1 = (a2 * a2).mul_by_nonresidue() - a0 * a1
+        t2 = a1 * a1 - a0 * a2
+        d = (a0 * t0 + (a2 * t1).mul_by_nonresidue()
+             + (a1 * t2).mul_by_nonresidue()).inv()
+        return Fq6(t0 * d, t1 * d, t2 * d)
+
+    def is_zero(self) -> bool:
+        return self.c0.is_zero() and self.c1.is_zero() and self.c2.is_zero()
+
+    @staticmethod
+    def zero() -> "Fq6": return Fq6(Fq2.zero(), Fq2.zero(), Fq2.zero())
+    @staticmethod
+    def one() -> "Fq6": return Fq6(Fq2.one(), Fq2.zero(), Fq2.zero())
+
+
+class Fq12:
+    """c0 + c1*w with w^2 = v."""
+    __slots__ = ("c0", "c1")
+
+    def __init__(self, c0: Fq6, c1: Fq6):
+        self.c0, self.c1 = c0, c1
+
+    def __add__(self, o): return Fq12(self.c0 + o.c0, self.c1 + o.c1)
+    def __sub__(self, o): return Fq12(self.c0 - o.c0, self.c1 - o.c1)
+    def __neg__(self): return Fq12(-self.c0, -self.c1)
+
+    def __mul__(self, o):
+        if isinstance(o, (Fq, Fq2)):
+            return Fq12(self.c0 * o, self.c1 * o)
+        a0, a1, b0, b1 = self.c0, self.c1, o.c0, o.c1
+        t0 = a0 * b0
+        t1 = a1 * b1
+        return Fq12(t0 + t1.mul_by_v(), a0 * b1 + a1 * b0)
+
+    def __eq__(self, o):
+        return isinstance(o, Fq12) and self.c0 == o.c0 and self.c1 == o.c1
+
+    def __repr__(self):
+        return f"Fq12({self.c0!r}, {self.c1!r})"
+
+    def conjugate(self) -> "Fq12":
+        """The p^6-power Frobenius: in the cyclotomic subgroup this is
+        the inverse."""
+        return Fq12(self.c0, -self.c1)
+
+    def inv(self) -> "Fq12":
+        a0, a1 = self.c0, self.c1
+        d = (a0 * a0 - (a1 * a1).mul_by_v()).inv()
+        return Fq12(a0 * d, -(a1 * d))
+
+    def __truediv__(self, o): return self * o.inv()
+
+    def __pow__(self, e: int) -> "Fq12":
+        if e < 0:
+            return self.inv() ** (-e)
+        result, base = Fq12.one(), self
+        while e:
+            if e & 1:
+                result = result * base
+            base = base * base
+            e >>= 1
+        return result
+
+    def is_zero(self) -> bool:
+        return self.c0.is_zero() and self.c1.is_zero()
+
+    @staticmethod
+    def zero() -> "Fq12": return Fq12(Fq6.zero(), Fq6.zero())
+    @staticmethod
+    def one() -> "Fq12": return Fq12(Fq6.one(), Fq6.zero())
+
+    @staticmethod
+    def from_fq2(x: Fq2) -> "Fq12":
+        return Fq12(Fq6(x, Fq2.zero(), Fq2.zero()), Fq6.zero())
+
+    @staticmethod
+    def from_fq(x: Fq) -> "Fq12":
+        return Fq12.from_fq2(Fq2(x, Fq.zero()))
+
+
+# Distinguished elements used by the untwist map: v and w themselves.
+V_FQ12 = Fq12(Fq6(Fq2.zero(), Fq2.one(), Fq2.zero()), Fq6.zero())
+W_FQ12 = Fq12(Fq6.zero(), Fq6.one())
+
+# Frobenius constants: gamma1 = xi^((p-1)/6); w^p = gamma1 * w and
+# v^p = gamma1^2 * v (since w^2 = v, v^3 = xi, p = 1 mod 6).
+_G1C = XI ** ((P - 1) // 6)
+_G2C = _G1C * _G1C          # xi^((p-1)/3)
+_G4C = _G2C * _G2C
+
+
+def _frob6(a: Fq6) -> Fq6:
+    return Fq6(a.c0.conjugate(), a.c1.conjugate() * _G2C,
+               a.c2.conjugate() * _G4C)
+
+
+def _frob12(f: Fq12) -> Fq12:
+    return Fq12(_frob6(f.c0), _frob6(f.c1) * _G1C)
+
+
+def fq12_frobenius(f: Fq12, power: int = 1) -> Fq12:
+    """f^(p^power) via coefficient-wise Frobenius (cheap, no pow)."""
+    for _ in range(power % 12):
+        f = _frob12(f)
+    return f

@@ -1,0 +1,214 @@
+"""Pure-Python hash-to-curve for BLS12-381 G2.
+
+Suite BLS12381G2_XMD:SHA-256_SSWU_RO_ (hash-to-curve draft/RFC 9380):
+expand_message_xmd(SHA-256) -> hash_to_field(Fq2, 2) -> simplified SWU
+on an isogenous curve E' -> 3-isogeny to E -> clear cofactor.
+
+Reference analog: blst's hash_to_G2 / `HashToG2` used for attestation
+and block signing roots (crypto/bls L0 [U, SURVEY.md §2]).
+
+The SSWU/isogeny constants below are standard published suite constants;
+they are NOT trusted blindly — tests verify (a) SSWU outputs land on E',
+(b) the isogeny maps E' points onto E, (c) the isogeny is a group
+homomorphism, (d) full hash_to_g2 outputs are in the r-order subgroup.
+Any wrong constant fails those with overwhelming probability.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ....utils import xor_bytes
+from ..params import H_EFF_G2, P
+from .curve import B2, add, is_on_curve, multiply
+from .fields import Fq, Fq2
+
+# --- Suite parameters -----------------------------------------------------
+
+# Isogenous curve E': y^2 = x^3 + A'x + B'
+ISO_A = Fq2.from_ints(0, 240)
+ISO_B = Fq2.from_ints(1012, 1012)
+# SSWU Z
+Z_SSWU = Fq2.from_ints(P - 2, P - 1)  # -(2 + u)
+
+# 3-isogeny map E' -> E, x = x_num/x_den, y = y * y_num/y_den
+_XNUM = [
+    Fq2.from_ints(
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+    ),
+    Fq2.from_ints(
+        0,
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A,
+    ),
+    Fq2.from_ints(
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D,
+    ),
+    Fq2.from_ints(
+        0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1,
+        0,
+    ),
+]
+_XDEN = [
+    Fq2.from_ints(
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63,
+    ),
+    Fq2.from_ints(
+        0xC,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F,
+    ),
+    Fq2.from_ints(1, 0),  # monic degree-2
+]
+_YNUM = [
+    Fq2.from_ints(
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+    ),
+    Fq2.from_ints(
+        0,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE,
+    ),
+    Fq2.from_ints(
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F,
+    ),
+    Fq2.from_ints(
+        0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10,
+        0,
+    ),
+]
+_YDEN = [
+    Fq2.from_ints(
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+    ),
+    Fq2.from_ints(
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3,
+    ),
+    Fq2.from_ints(
+        0x12,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99,
+    ),
+    Fq2.from_ints(1, 0),  # monic degree-3
+]
+
+# --- expand_message_xmd ---------------------------------------------------
+
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    """RFC 9380 §5.3.1 with SHA-256 (b=32, s=64)."""
+    if len(dst) > 255:
+        raise ValueError("DST too long")
+    b_in_bytes, s_in_bytes = 32, 64
+    ell = (len_in_bytes + b_in_bytes - 1) // b_in_bytes
+    if ell > 255:
+        raise ValueError("len_in_bytes too large")
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = b"\x00" * s_in_bytes
+    l_i_b_str = len_in_bytes.to_bytes(2, "big")
+    msg_prime = z_pad + msg + l_i_b_str + b"\x00" + dst_prime
+    b0 = hashlib.sha256(msg_prime).digest()
+    b1 = hashlib.sha256(b0 + b"\x01" + dst_prime).digest()
+    bs = [b1]
+    for i in range(2, ell + 1):
+        xored = xor_bytes(b0, bs[-1])
+        bs.append(hashlib.sha256(xored + bytes([i]) + dst_prime).digest())
+    return b"".join(bs)[:len_in_bytes]
+
+
+def hash_to_field_fq2(msg: bytes, count: int, dst: bytes) -> list[Fq2]:
+    """RFC 9380 §5.2: m=2, L=64."""
+    L = 64
+    pseudo = expand_message_xmd(msg, dst, count * 2 * L)
+    out = []
+    for i in range(count):
+        e = []
+        for j in range(2):
+            off = L * (j + i * 2)
+            e.append(int.from_bytes(pseudo[off:off + L], "big") % P)
+        out.append(Fq2.from_ints(e[0], e[1]))
+    return out
+
+
+# --- SSWU map to E' -------------------------------------------------------
+
+
+def _is_square(a: Fq2) -> bool:
+    norm = a.c0 * a.c0 + a.c1 * a.c1
+    return pow(norm.n, (P - 1) // 2, P) != P - 1
+
+
+def map_to_curve_sswu(u: Fq2):
+    """Simplified SWU for AB != 0 (RFC 9380 §6.6.2), onto E'."""
+    A, B, Z = ISO_A, ISO_B, Z_SSWU
+    zu2 = Z * (u * u)
+    tv1 = zu2 * zu2 + zu2              # Z^2 u^4 + Z u^2
+    x1num = B * (tv1 + Fq2.one())      # B (tv1 + 1)
+    if tv1.is_zero():
+        x1den = A * Z
+    else:
+        x1den = -(A * tv1)
+    # gx1 = x1^3 + A x1 + B, with x1 = x1num / x1den, tracked fractionally:
+    # gx1 = (x1num^3 + A x1num x1den^2 + B x1den^3) / x1den^3
+    x1den2 = x1den * x1den
+    x1den3 = x1den2 * x1den
+    gx1num = x1num * x1num * x1num + A * x1num * x1den2 + B * x1den3
+    # gx1 = gx1num / x1den3 ; square iff gx1num * x1den3 is square
+    if _is_square(gx1num * x1den3):
+        x_num, g_num, g_den = x1num, gx1num, x1den3
+        xden = x1den
+    else:
+        # x2 = Z u^2 x1
+        x_num = zu2 * x1num
+        xden = x1den
+        # gx2 = gx1 * (Z u^2)^3 = Z^3 u^6 gx1
+        g_num = zu2 * zu2 * zu2 * gx1num
+        g_den = x1den3
+    x = x_num / xden
+    y2 = g_num / g_den
+    y = y2.sqrt()
+    assert y is not None, "SSWU: expected square"
+    if u.sgn0() != y.sgn0():
+        y = -y
+    return (x, y)
+
+
+def _horner(coeffs: list[Fq2], x: Fq2) -> Fq2:
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = acc * x + c
+    return acc
+
+
+def iso_map_to_e2(pt):
+    """3-isogeny E' -> E (G2 curve)."""
+    if pt is None:
+        return None
+    x, y = pt
+    xnum = _horner(_XNUM, x)
+    xden = _horner(_XDEN, x)
+    ynum = _horner(_YNUM, x)
+    yden = _horner(_YDEN, x)
+    if xden.is_zero() or yden.is_zero():
+        return None
+    return (xnum / xden, y * (ynum / yden))
+
+
+# --- full hash_to_g2 ------------------------------------------------------
+
+
+def clear_cofactor_g2(pt):
+    return multiply(pt, H_EFF_G2)
+
+
+def hash_to_g2(msg: bytes, dst: bytes):
+    u0, u1 = hash_to_field_fq2(msg, 2, dst)
+    q0 = iso_map_to_e2(map_to_curve_sswu(u0))
+    q1 = iso_map_to_e2(map_to_curve_sswu(u1))
+    r = add(q0, q1)
+    p = clear_cofactor_g2(r)
+    assert is_on_curve(p, B2)
+    return p

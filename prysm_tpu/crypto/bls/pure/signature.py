@@ -1,0 +1,193 @@
+"""Pure-Python Eth2-style BLS signatures (min-pubkey-size: PK in G1,
+sig in G2, proof-of-possession ciphersuite DST).
+
+Reference analog: the crypto/bls herumi/blst implementations'
+Sign/Verify/Aggregate/FastAggregateVerify surface [U, SURVEY.md §2
+'BLS interface']. Serialization follows the ZCash BLS12-381 format the
+reference uses on the wire (compressed 48-byte G1 / 96-byte G2 with
+compression/infinity/sort flag bits).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..params import ETH2_DST, P, R
+from .curve import B1, B2, G1_GEN, add, multiply, neg
+from .fields import Fq, Fq2, Fq12
+from .hash_to_curve import hash_to_g2
+from .pairing import multi_pairing
+
+# --- point serialization (ZCash format) -----------------------------------
+
+_C_FLAG = 0x80  # compression
+_I_FLAG = 0x40  # infinity
+_S_FLAG = 0x20  # sort (y is lexicographically larger)
+
+
+def _fq_larger(y: Fq) -> bool:
+    return y.n > (P - 1) // 2
+
+
+def _fq2_larger(y: Fq2) -> bool:
+    if y.c1.n != 0:
+        return y.c1.n > (P - 1) // 2
+    return y.c0.n > (P - 1) // 2
+
+
+def g1_to_bytes(pt) -> bytes:
+    if pt is None:
+        return bytes([_C_FLAG | _I_FLAG]) + b"\x00" * 47
+    x, y = pt
+    b = bytearray(x.n.to_bytes(48, "big"))
+    b[0] |= _C_FLAG
+    if _fq_larger(y):
+        b[0] |= _S_FLAG
+    return bytes(b)
+
+
+def g1_from_bytes(data: bytes, subgroup_check: bool = False):
+    if len(data) != 48:
+        raise ValueError("G1 compressed point must be 48 bytes")
+    flags = data[0]
+    if not flags & _C_FLAG:
+        raise ValueError("uncompressed G1 not supported")
+    if flags & _I_FLAG:
+        if any(data[1:]) or flags & _S_FLAG or data[0] != (_C_FLAG | _I_FLAG):
+            raise ValueError("invalid infinity encoding")
+        return None
+    x_int = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:], "big")
+    if x_int >= P:
+        raise ValueError("x not in field")
+    x = Fq(x_int)
+    y2 = x * x * x + B1
+    y = y2.sqrt()
+    if y is None:
+        raise ValueError("x not on curve")
+    if bool(flags & _S_FLAG) != _fq_larger(y):
+        y = -y
+    pt = (x, y)
+    if subgroup_check and multiply(pt, R) is not None:
+        raise ValueError("G1 point not in r-order subgroup")
+    return pt
+
+
+def g2_to_bytes(pt) -> bytes:
+    if pt is None:
+        return bytes([_C_FLAG | _I_FLAG]) + b"\x00" * 95
+    x, y = pt
+    b = bytearray(x.c1.n.to_bytes(48, "big") + x.c0.n.to_bytes(48, "big"))
+    b[0] |= _C_FLAG
+    if _fq2_larger(y):
+        b[0] |= _S_FLAG
+    return bytes(b)
+
+
+def g2_from_bytes(data: bytes, subgroup_check: bool = False):
+    if len(data) != 96:
+        raise ValueError("G2 compressed point must be 96 bytes")
+    flags = data[0]
+    if not flags & _C_FLAG:
+        raise ValueError("uncompressed G2 not supported")
+    if flags & _I_FLAG:
+        if any(data[1:]) or data[0] != (_C_FLAG | _I_FLAG):
+            raise ValueError("invalid infinity encoding")
+        return None
+    x_c1 = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+    x_c0 = int.from_bytes(data[48:], "big")
+    if x_c0 >= P or x_c1 >= P:
+        raise ValueError("x not in field")
+    x = Fq2.from_ints(x_c0, x_c1)
+    y2 = x * x * x + B2
+    y = y2.sqrt()
+    if y is None:
+        raise ValueError("x not on curve")
+    if bool(flags & _S_FLAG) != _fq2_larger(y):
+        y = -y
+    pt = (x, y)
+    if subgroup_check and multiply(pt, R) is not None:
+        raise ValueError("G2 point not in r-order subgroup")
+    return pt
+
+
+def key_validate(pk_bytes: bytes) -> bool:
+    """KeyValidate: non-infinity, on curve, in the r-order subgroup."""
+    try:
+        pt = g1_from_bytes(pk_bytes, subgroup_check=True)
+    except ValueError:
+        return False
+    return pt is not None
+
+
+# --- key generation -------------------------------------------------------
+
+
+def deterministic_secret_key(index: int) -> int:
+    """Deterministic test keys (testing/util DeterministicGenesisState
+    analog [U, SURVEY.md §4]): sk_i = SHA-256(i as 32-byte LE) mod r,
+    re-hashed until nonzero."""
+    data = index.to_bytes(32, "little")
+    while True:
+        h = hashlib.sha256(data).digest()
+        sk = int.from_bytes(h, "little") % R
+        if sk != 0:
+            return sk
+        data = h
+
+
+def sk_to_pubkey_point(sk: int):
+    return multiply(G1_GEN, sk % R)
+
+
+def sk_to_pubkey(sk: int) -> bytes:
+    return g1_to_bytes(sk_to_pubkey_point(sk))
+
+
+# --- core scheme ----------------------------------------------------------
+
+
+def sign_point(sk: int, msg: bytes, dst: bytes = ETH2_DST):
+    return multiply(hash_to_g2(msg, dst), sk % R)
+
+
+def sign(sk: int, msg: bytes, dst: bytes = ETH2_DST) -> bytes:
+    return g2_to_bytes(sign_point(sk, msg, dst))
+
+
+def verify_points(pk_pt, msg: bytes, sig_pt, dst: bytes = ETH2_DST) -> bool:
+    if pk_pt is None or sig_pt is None:
+        return False
+    h = hash_to_g2(msg, dst)
+    # e(g1, sig) == e(pk, H(msg))
+    return multi_pairing([(neg(G1_GEN), sig_pt), (pk_pt, h)]) == Fq12.one()
+
+
+def aggregate_points(points):
+    acc = None
+    for pt in points:
+        acc = add(acc, pt)
+    return acc
+
+
+def fast_aggregate_verify_points(pk_pts, msg: bytes, sig_pt,
+                                 dst: bytes = ETH2_DST) -> bool:
+    """All signers signed the same message: one pairing per committee —
+    the attestation fast path the north star batches."""
+    if not pk_pts or sig_pt is None:
+        return False
+    apk = aggregate_points(pk_pts)
+    if apk is None:
+        return False
+    return verify_points(apk, msg, sig_pt, dst)
+
+
+def aggregate_verify_points(pk_pts, msgs, sig_pt,
+                            dst: bytes = ETH2_DST) -> bool:
+    if not pk_pts or len(pk_pts) != len(msgs) or sig_pt is None:
+        return False
+    if any(pk is None for pk in pk_pts):
+        return False
+    pairs = [(neg(G1_GEN), sig_pt)]
+    for pk, msg in zip(pk_pts, msgs):
+        pairs.append((pk, hash_to_g2(msg, dst)))
+    return multi_pairing(pairs) == Fq12.one()

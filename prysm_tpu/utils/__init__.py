@@ -1,0 +1,7 @@
+from .bytesutil import (  # noqa: F401
+    int_to_bytes,
+    bytes_to_int,
+    to_bytes32,
+    hex_str,
+    xor_bytes,
+)

@@ -1,0 +1,27 @@
+"""Byte/int helpers (reference: encoding/bytesutil/ [U])."""
+
+from __future__ import annotations
+
+
+def int_to_bytes(x: int, length: int, byteorder: str = "little") -> bytes:
+    return int(x).to_bytes(length, byteorder)
+
+
+def bytes_to_int(b: bytes, byteorder: str = "little") -> int:
+    return int.from_bytes(b, byteorder)
+
+
+def to_bytes32(b: bytes) -> bytes:
+    if len(b) > 32:
+        raise ValueError(f"value too long for bytes32: {len(b)}")
+    return b.ljust(32, b"\x00")
+
+
+def hex_str(b: bytes) -> str:
+    return "0x" + b.hex()
+
+
+def xor_bytes(a: bytes, b: bytes) -> bytes:
+    if len(a) != len(b):
+        raise ValueError("length mismatch")
+    return bytes(x ^ y for x, y in zip(a, b))

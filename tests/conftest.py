@@ -1,0 +1,16 @@
+"""Force tests onto a virtual 8-device CPU mesh.
+
+The real TPU (1 chip) is reserved for bench.py; unit tests exercise
+sharding on a virtual CPU mesh per the driver contract. Must run before
+jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,224 @@
+"""Tests for the trusted pure-Python BLS12-381 reference.
+
+Mirrors the reference's crypto/bls unit tests + spec bls/ suite role
+[U, SURVEY.md §4]: with no network to fetch official vectors, correctness
+is established by structural invariants (on-curve, orders, bilinearity,
+homomorphism) that fail w.h.p. for any wrong constant or formula.
+"""
+
+import random
+
+import pytest
+
+from prysm_tpu.crypto.bls.params import ETH2_DST, FINAL_EXP, P, R
+from prysm_tpu.crypto.bls.pure import curve as c
+from prysm_tpu.crypto.bls.pure import hash_to_curve as h2c
+from prysm_tpu.crypto.bls.pure import pairing as pr
+from prysm_tpu.crypto.bls.pure import signature as sig
+from prysm_tpu.crypto.bls.pure.fields import Fq, Fq2, Fq6, Fq12, fq12_frobenius
+
+rng = random.Random(1234)
+
+
+def rand_fq2():
+    return Fq2.from_ints(rng.randrange(P), rng.randrange(P))
+
+
+def rand_fq12():
+    return Fq12(
+        Fq6(rand_fq2(), rand_fq2(), rand_fq2()),
+        Fq6(rand_fq2(), rand_fq2(), rand_fq2()),
+    )
+
+
+class TestFields:
+    def test_fq_inv(self):
+        for _ in range(10):
+            a = Fq(rng.randrange(1, P))
+            assert a * a.inv() == Fq.one()
+
+    def test_fq2_mul_inv_roundtrip(self):
+        for _ in range(10):
+            a = rand_fq2()
+            if a.is_zero():
+                continue
+            assert a * a.inv() == Fq2.one()
+
+    def test_fq2_nonresidue_is_v_cubed(self):
+        # (1+u) must be a cubic non-residue for the tower to be a field:
+        # v^3 = xi; check xi^((p^2-1)/3) != 1.
+        from prysm_tpu.crypto.bls.pure.fields import XI
+        assert XI ** ((P * P - 1) // 3) != Fq2.one()
+
+    def test_fq12_mul_inv_roundtrip(self):
+        a = rand_fq12()
+        assert a * a.inv() == Fq12.one()
+
+    def test_fq12_associativity_distributivity(self):
+        a, b, cc = rand_fq12(), rand_fq12(), rand_fq12()
+        assert (a * b) * cc == a * (b * cc)
+        assert a * (b + cc) == a * b + a * cc
+
+    def test_frobenius_matches_pow(self):
+        a = rand_fq12()
+        assert fq12_frobenius(a, 1) == a ** P
+
+    def test_fq2_sqrt(self):
+        for _ in range(5):
+            a = rand_fq2()
+            s = a * a
+            r = s.sqrt()
+            assert r is not None and r * r == s
+
+
+class TestCurve:
+    def test_generators_on_curve(self):
+        assert c.is_on_curve(c.G1_GEN, c.B1)
+        assert c.is_on_curve(c.G2_GEN, c.B2)
+
+    def test_generator_orders(self):
+        assert c.multiply(c.G1_GEN, R) is None
+        assert c.multiply(c.G2_GEN, R) is None
+
+    def test_add_double_consistency(self):
+        p2 = c.double(c.G1_GEN)
+        p3a = c.add(p2, c.G1_GEN)
+        p3b = c.add(c.G1_GEN, p2)
+        assert p3a == p3b
+        assert c.multiply(c.G1_GEN, 3) == p3a
+
+    def test_scalar_mul_distributes(self):
+        a, b = rng.randrange(1, R), rng.randrange(1, R)
+        lhs = c.multiply(c.G2_GEN, (a + b) % R)
+        rhs = c.add(c.multiply(c.G2_GEN, a), c.multiply(c.G2_GEN, b))
+        assert lhs == rhs
+
+    def test_neg(self):
+        assert c.add(c.G1_GEN, c.neg(c.G1_GEN)) is None
+
+
+class TestPairing:
+    def test_nondegenerate_and_order(self):
+        e = pr.pairing(c.G1_GEN, c.G2_GEN)
+        assert e != Fq12.one()
+        assert e ** R == Fq12.one()
+
+    def test_bilinearity(self):
+        a = rng.randrange(2, 2**32)
+        e1 = pr.pairing(c.multiply(c.G1_GEN, a), c.G2_GEN)
+        e2 = pr.pairing(c.G1_GEN, c.multiply(c.G2_GEN, a))
+        e = pr.pairing(c.G1_GEN, c.G2_GEN)
+        assert e1 == e2 == e ** a
+
+    def test_final_exp_fast_equals_slow(self):
+        f = pr.miller_loop(pr.untwist(c.G2_GEN), pr.lift_g1(c.G1_GEN))
+        assert pr.final_exponentiation(f) == pr.final_exponentiation_slow(f)
+
+    def test_pairings_equal(self):
+        s = rng.randrange(1, R)
+        assert pr.pairings_equal(
+            c.multiply(c.G1_GEN, s), c.G2_GEN,
+            c.G1_GEN, c.multiply(c.G2_GEN, s),
+        )
+        assert not pr.pairings_equal(
+            c.multiply(c.G1_GEN, s + 1), c.G2_GEN,
+            c.G1_GEN, c.multiply(c.G2_GEN, s),
+        )
+
+
+class TestHashToCurve:
+    def test_expand_message_xmd_lengths(self):
+        out = h2c.expand_message_xmd(b"abc", b"QUUX-V01-CS02", 0x80)
+        assert len(out) == 0x80
+        out2 = h2c.expand_message_xmd(b"abc", b"QUUX-V01-CS02", 32)
+        assert len(out2) == 32
+        assert out[:32] != out2  # domain separated by length
+
+    def test_sswu_on_isogenous_curve(self):
+        for _ in range(4):
+            u = rand_fq2()
+            x, y = h2c.map_to_curve_sswu(u)
+            assert y * y == x * x * x + h2c.ISO_A * x + h2c.ISO_B
+
+    def test_iso_map_image_on_e2(self):
+        u = rand_fq2()
+        pt = h2c.iso_map_to_e2(h2c.map_to_curve_sswu(u))
+        assert c.is_on_curve(pt, c.B2)
+
+    def test_iso_map_is_homomorphism(self):
+        p1 = h2c.map_to_curve_sswu(rand_fq2())
+        p2 = h2c.map_to_curve_sswu(rand_fq2())
+        lhs = h2c.iso_map_to_e2(c.add(p1, p2))
+        rhs = c.add(h2c.iso_map_to_e2(p1), h2c.iso_map_to_e2(p2))
+        assert lhs == rhs
+
+    def test_hash_to_g2_in_subgroup(self):
+        pt = h2c.hash_to_g2(b"prysm_tpu test", ETH2_DST)
+        assert c.is_on_curve(pt, c.B2)
+        assert c.multiply(pt, R) is None
+
+    def test_hash_to_g2_deterministic_and_injectivelike(self):
+        a = h2c.hash_to_g2(b"msg-a", ETH2_DST)
+        a2 = h2c.hash_to_g2(b"msg-a", ETH2_DST)
+        b = h2c.hash_to_g2(b"msg-b", ETH2_DST)
+        assert a == a2
+        assert a != b
+
+
+class TestSignature:
+    def test_sign_verify_roundtrip(self):
+        sk = sig.deterministic_secret_key(0)
+        pk = sig.sk_to_pubkey_point(sk)
+        msg = b"attestation data root"
+        s = sig.sign_point(sk, msg)
+        assert sig.verify_points(pk, msg, s)
+        assert not sig.verify_points(pk, b"other msg", s)
+        sk2 = sig.deterministic_secret_key(1)
+        assert not sig.verify_points(sig.sk_to_pubkey_point(sk2), msg, s)
+
+    def test_fast_aggregate_verify(self):
+        msg = b"same message for committee"
+        sks = [sig.deterministic_secret_key(i) for i in range(4)]
+        pks = [sig.sk_to_pubkey_point(sk) for sk in sks]
+        agg = sig.aggregate_points([sig.sign_point(sk, msg) for sk in sks])
+        assert sig.fast_aggregate_verify_points(pks, msg, agg)
+        assert not sig.fast_aggregate_verify_points(pks[:3], msg, agg)
+
+    def test_aggregate_verify_distinct_messages(self):
+        sks = [sig.deterministic_secret_key(i) for i in range(3)]
+        pks = [sig.sk_to_pubkey_point(sk) for sk in sks]
+        msgs = [b"m0", b"m1", b"m2"]
+        agg = sig.aggregate_points(
+            [sig.sign_point(sk, m) for sk, m in zip(sks, msgs)])
+        assert sig.aggregate_verify_points(pks, msgs, agg)
+        assert not sig.aggregate_verify_points(pks, [b"m0", b"m1", b"mX"], agg)
+
+    def test_g1_serialization_roundtrip(self):
+        for i in range(3):
+            pt = c.multiply(c.G1_GEN, rng.randrange(1, R))
+            assert sig.g1_from_bytes(sig.g1_to_bytes(pt)) == pt
+        assert sig.g1_from_bytes(sig.g1_to_bytes(None)) is None
+
+    def test_g2_serialization_roundtrip(self):
+        for i in range(3):
+            pt = c.multiply(c.G2_GEN, rng.randrange(1, R))
+            assert sig.g2_from_bytes(sig.g2_to_bytes(pt)) == pt
+        assert sig.g2_from_bytes(sig.g2_to_bytes(None)) is None
+
+    def test_noncanonical_infinity_rejected(self):
+        with pytest.raises(ValueError):
+            sig.g1_from_bytes(bytes([0xC1]) + b"\x00" * 47)
+        with pytest.raises(ValueError):
+            sig.g2_from_bytes(bytes([0xC1]) + b"\x00" * 95)
+
+    def test_subgroup_check_rejects_low_order_point(self):
+        # x=5 happens to be on E1 but outside the r-order subgroup
+        raw = bytes([0x80]) + b"\x00" * 46 + b"\x05"
+        assert sig.g1_from_bytes(raw) is not None  # decodes without check
+        with pytest.raises(ValueError):
+            sig.g1_from_bytes(raw, subgroup_check=True)
+
+    def test_pubkey_48_bytes_sig_96_bytes(self):
+        sk = sig.deterministic_secret_key(7)
+        assert len(sig.sk_to_pubkey(sk)) == 48
+        assert len(sig.sign(sk, b"x")) == 96
