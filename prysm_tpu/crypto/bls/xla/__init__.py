@@ -1,0 +1,8 @@
+"""JAX/XLA BLS12-381 backend (the TPU-native analog of the reference's
+vendored blst, crypto/bls L0 [U, SURVEY.md §2.1.1]).
+
+Layering: limbs (Fp Montgomery arithmetic) -> tower (Fq2/Fq6/Fq12) ->
+curve (Jacobian G1/G2) -> pairing (Miller loop + final exp) -> h2c
+(hash-to-G2) -> verify (signature API).  Every layer is differential-
+tested against ``prysm_tpu.crypto.bls.pure``.
+"""

@@ -1,0 +1,282 @@
+"""BLS12-381 base-field arithmetic as JAX limb vectors (the TPU backend).
+
+Reference analog: blst's 384-bit Montgomery field arithmetic (C + asm)
+vendored under the reference's ``crypto/bls`` [U, SURVEY.md §2 L0,
+§2.1.1].  This module replaces hand-written x86/ARM carry-chain asm with
+an XLA-friendly formulation.
+
+Design (the "limb decision", SURVEY.md §7 stage 1):
+
+* An Fp element is ``uint32[..., 24]`` — 24 little-endian limbs in radix
+  ``2**16``.  TPUs have no usable 64-bit integer multiply, but a 32-bit
+  multiply of two 16-bit limbs is exact in uint32, so schoolbook partial
+  products never overflow.  Each product is immediately split into
+  16-bit lo/hi halves; column accumulators then hold sums of at most
+  ~96 half-products (< 2**23), comfortably inside uint32.  This beats
+  the 32-bit-limb alternative (which would need 64-bit accumulation XLA
+  must emulate) and the 8-bit alternative (2x the limbs, 4x the partial
+  products, no headroom win that matters).
+* Montgomery representation (R = 2**384) with SOS reduction performed
+  directly on the redundant column accumulator: at step i the low 16
+  bits of column i are exact because every contribution to it (initial
+  products, earlier m_j*N additions, and the sequential carry from
+  column i-1) has already landed, so ``m = t_i * (-P^-1) mod 2**16``
+  is computed without a full carry normalization.
+* Every op works over arbitrary leading batch dims; batching signatures
+  / points / tower coefficients is a reshape, not a vmap — one fused
+  elementwise graph per field op, which is what the TPU VPU wants.
+* All loops over limb indices are Python-unrolled (static); loops over
+  exponent bits use ``lax.scan`` so the traced graph stays small.
+
+Values are kept canonical (< P) at op boundaries; Montgomery products
+come out < 2P and are conditionally reduced once.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..params import P
+
+RADIX_BITS = 16
+RADIX = 1 << RADIX_BITS
+MASK32 = np.uint32(RADIX - 1)
+NLIMBS = 24  # 24 * 16 = 384 bits >= 381
+NBITS = NLIMBS * RADIX_BITS
+
+# --- host-side constants ---------------------------------------------------
+
+
+def int_to_limbs_np(x: int) -> np.ndarray:
+    """Python int -> uint32[24] little-endian radix-2**16 limbs."""
+    if x < 0 or x >> NBITS:
+        raise ValueError("value out of range for 384-bit limbs")
+    return np.array([(x >> (RADIX_BITS * i)) & (RADIX - 1)
+                     for i in range(NLIMBS)], dtype=np.uint32)
+
+
+def limbs_to_int(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(v) << (RADIX_BITS * i) for i, v in enumerate(arr))
+
+
+P_LIMBS = int_to_limbs_np(P)
+# -P^{-1} mod 2**16 (the Montgomery n0' constant for the lowest limb)
+N0 = np.uint32((-pow(P, -1, RADIX)) % RADIX)
+R_MOD_P = (1 << NBITS) % P
+R2_MOD_P = pow(1 << NBITS, 2, P)
+ONE_MONT = int_to_limbs_np(R_MOD_P)        # 1 in Montgomery form
+R2_LIMBS = int_to_limbs_np(R2_MOD_P)
+ZERO = np.zeros(NLIMBS, dtype=np.uint32)
+
+# --- carry / compare helpers ----------------------------------------------
+
+
+def _carry_norm(cols, n_out: int):
+    """Ripple-carry a redundant column vector (entries < 2**26) into
+    canonical 16-bit limbs.  Returns uint32[..., n_out]; any carry out
+    of the top requested limb is dropped (callers guarantee it is 0)."""
+    carry = jnp.zeros(cols.shape[:-1], dtype=jnp.uint32)
+    outs = []
+    for i in range(n_out):
+        v = cols[..., i] + carry
+        outs.append(v & MASK32)
+        carry = v >> RADIX_BITS
+    return jnp.stack(outs, axis=-1)
+
+
+def _sub_borrow(a, b_limbs):
+    """a - b over 24 limbs; returns (diff mod 2**384, borrow in {0,1})."""
+    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    outs = []
+    for i in range(NLIMBS):
+        d = a[..., i] + np.uint32(RADIX) - b_limbs[..., i] - borrow
+        outs.append(d & MASK32)
+        borrow = jnp.uint32(1) - (d >> RADIX_BITS)
+    return jnp.stack(outs, axis=-1), borrow
+
+
+def _add_limbs_mod_2_384(a, b_limbs):
+    s = a + b_limbs  # entries < 2**17
+    return _carry_norm(s, NLIMBS)
+
+
+def _csub_p(x):
+    """Conditionally subtract P once (canonicalize a value < 2P)."""
+    p = jnp.asarray(P_LIMBS)
+    diff, borrow = _sub_borrow(x, jnp.broadcast_to(p, x.shape))
+    return jnp.where((borrow == 0)[..., None], diff, x)
+
+
+# --- field ops -------------------------------------------------------------
+
+
+@jax.jit
+def fp_add(a, b):
+    return _csub_p(_add_limbs_mod_2_384(a, b))
+
+
+@jax.jit
+def fp_sub(a, b):
+    d, borrow = _sub_borrow(a, b)
+    wrapped = _add_limbs_mod_2_384(d, jnp.broadcast_to(jnp.asarray(P_LIMBS),
+                                                       d.shape))
+    return jnp.where((borrow == 1)[..., None], wrapped, d)
+
+
+@jax.jit
+def fp_neg(a):
+    return fp_sub(jnp.zeros_like(a), a)
+
+
+@partial(jax.jit, static_argnums=1)
+def fp_mul_small(a, k: int):
+    """a * k for tiny static k (used for 2x/3x/8x in curve formulas)."""
+    out = jnp.zeros_like(a)
+    acc = a
+    while k:
+        if k & 1:
+            out = fp_add(out, acc)
+        k >>= 1
+        if k:
+            acc = fp_add(acc, acc)
+    return out
+
+
+def _mul_columns(a, b):
+    """Full 768-bit schoolbook product as 49 redundant columns."""
+    prods = a[..., :, None] * b[..., None, :]          # (..., 24, 24) u32
+    lo = prods & MASK32
+    hi = prods >> RADIX_BITS
+    cols = jnp.zeros(prods.shape[:-2] + (2 * NLIMBS + 1,), dtype=jnp.uint32)
+    for i in range(NLIMBS):
+        cols = cols.at[..., i:i + NLIMBS].add(lo[..., i, :])
+        cols = cols.at[..., i + 1:i + NLIMBS + 1].add(hi[..., i, :])
+    return cols
+
+
+def _mont_reduce(cols):
+    """Montgomery-reduce 49 redundant columns -> canonical 24 limbs.
+
+    Column i's low 16 bits are exact at step i (see module docstring),
+    so m_i needs no prior carry normalization."""
+    p = jnp.asarray(P_LIMBS)
+    for i in range(NLIMBS):
+        ti = cols[..., i]
+        m = ((ti & MASK32) * N0) & MASK32
+        mp = m[..., None] * p                           # (..., 24)
+        cols = cols.at[..., i:i + NLIMBS].add(mp & MASK32)
+        cols = cols.at[..., i + 1:i + NLIMBS + 1].add(mp >> RADIX_BITS)
+        cols = cols.at[..., i + 1].add(cols[..., i] >> RADIX_BITS)
+    limbs = _carry_norm(cols[..., NLIMBS:], NLIMBS)
+    return _csub_p(limbs)
+
+
+@jax.jit
+def fp_mul(a, b):
+    """Montgomery product mont(a) * mont(b) -> mont(a*b)."""
+    return _mont_reduce(_mul_columns(a, b))
+
+
+@jax.jit
+def fp_sqr(a):
+    return fp_mul(a, a)
+
+
+@jax.jit
+def from_mont(a):
+    """Montgomery form -> standard residue limbs (multiply by 1)."""
+    one = jnp.zeros_like(a).at[..., 0].set(jnp.uint32(1))
+    return fp_mul(a, one)
+
+
+@jax.jit
+def to_mont(a):
+    """Standard residue limbs -> Montgomery form (multiply by R^2)."""
+    r2 = jnp.broadcast_to(jnp.asarray(R2_LIMBS), a.shape)
+    return fp_mul(a, r2)
+
+
+def fp_is_zero(a):
+    """Boolean (...,) — works for canonical limbs (mont(0) == 0)."""
+    return jnp.all(a == 0, axis=-1)
+
+
+def fp_eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def fp_select(cond, a, b):
+    """where(cond, a, b) with cond shaped (...,)."""
+    return jnp.where(cond[..., None], a, b)
+
+
+# --- fixed-exponent powers -------------------------------------------------
+
+
+def _bits_msb_first(e: int) -> np.ndarray:
+    if e <= 0:
+        raise ValueError("exponent must be positive")
+    return np.array([int(c) for c in bin(e)[2:]], dtype=np.uint32)
+
+
+@partial(jax.jit, static_argnums=1)
+def fp_pow_fixed(a, e: int):
+    """a**e for a static Python-int exponent, via lax.scan over the bit
+    string (left-to-right square-and-multiply, branchless select)."""
+    bits = _bits_msb_first(e)
+
+    def body(r, bit):
+        r = fp_sqr(r)
+        r = fp_select(jnp.broadcast_to(bit, r.shape[:-1]) == 1,
+                      fp_mul(r, a), r)
+        return r, None
+
+    # the leading bit is always 1: start from a and skip it
+    r, _ = lax.scan(body, a, jnp.asarray(bits[1:]))
+    return r
+
+
+@jax.jit
+def fp_inv(a):
+    """Fermat inversion a**(P-2); inverse of 0 is 0 (callers guard)."""
+    return fp_pow_fixed(a, P - 2)
+
+
+# --- host <-> device conversion -------------------------------------------
+
+
+def pack_ints(values, mont: bool = True) -> jnp.ndarray:
+    """List/array of Python ints -> uint32[n, 24] (Montgomery by default)."""
+    arr = np.stack([int_to_limbs_np(v % P) for v in values])
+    out = jnp.asarray(arr)
+    return to_mont(out) if mont else out
+
+
+def unflatten_list(shape, items) -> list:
+    """Rebuild a flat list into nested lists matching ``shape`` (the
+    shared helper for all unpack_* functions)."""
+    it = iter(items)
+
+    def build(s):
+        if not s:
+            return next(it)
+        return [build(s[1:]) for _ in range(s[0])]
+
+    return build(tuple(shape))
+
+
+def unpack_ints(limbs, mont: bool = True) -> list:
+    """uint32[..., 24] -> nested lists of Python ints."""
+    if mont:
+        limbs = from_mont(limbs)
+    arr = np.asarray(jax.device_get(limbs))
+    flat = arr.reshape(-1, NLIMBS)
+    ints = [limbs_to_int(row) for row in flat]
+    return unflatten_list(arr.shape[:-1], ints)

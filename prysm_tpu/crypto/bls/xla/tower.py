@@ -1,0 +1,384 @@
+"""BLS12-381 tower fields Fq2/Fq6/Fq12 over JAX limb vectors.
+
+Reference analog: blst's fp2/fp6/fp12 tower (crypto/bls L0 [U,
+SURVEY.md §2.1.1]).  Tower: Fq2 = Fq[u]/(u^2+1), Fq6 = Fq2[v]/(v^3-xi)
+with xi = 1+u, Fq12 = Fq6[w]/(w^2-v) — identical to the pure golden
+model so results diff-test bit-exactly.
+
+Shapes (all uint32, Montgomery-form limbs):
+  Fq2  (..., 2, 24)      c0 + c1*u
+  Fq6  (..., 3, 2, 24)   d0 + d1*v + d2*v^2
+  Fq12 (..., 2, 3, 2, 24) e0 + e1*w
+
+The key TPU trick: Karatsuba at every level exposes its sub-products as
+*independent* multiplications, so each level stacks its operands along
+a fresh leading axis and issues ONE call to the level below.  A full
+Fq12 multiply is a single batched Montgomery multiply of batch 54 —
+one fused elementwise graph, no Python-level loop blowup.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..params import P
+from ..pure import fields as pf
+from . import limbs as L
+
+# --- packing: pure-model objects <-> device arrays -------------------------
+
+
+def pack_fq2(values, mont: bool = True) -> jnp.ndarray:
+    """List of pure Fq2 (or (c0,c1) int tuples) -> uint32[n, 2, 24]."""
+    ints = []
+    for v in values:
+        if isinstance(v, pf.Fq2):
+            ints.extend([v.c0.n, v.c1.n])
+        else:
+            ints.extend([v[0], v[1]])
+    return L.pack_ints(ints, mont=mont).reshape(len(values), 2, L.NLIMBS)
+
+
+def unpack_fq2(arr, mont: bool = True):
+    """uint32[..., 2, 24] -> pure Fq2 objects (nested lists)."""
+    flat = jnp.reshape(arr, (-1, L.NLIMBS))
+    ints = L.unpack_ints(flat, mont=mont)
+    pairs = [pf.Fq2.from_ints(ints[i], ints[i + 1])
+             for i in range(0, len(ints), 2)]
+    return L.unflatten_list(arr.shape[:-2], pairs)
+
+
+def pack_fq12(values, mont: bool = True) -> jnp.ndarray:
+    """List of pure Fq12 -> uint32[n, 2, 3, 2, 24]."""
+    fq2s = []
+    for f in values:
+        for six in (f.c0, f.c1):
+            fq2s.extend([six.c0, six.c1, six.c2])
+    arr = pack_fq2(fq2s, mont=mont)
+    return arr.reshape(len(values), 2, 3, 2, L.NLIMBS)
+
+
+def unpack_fq12(arr, mont: bool = True):
+    """uint32[..., 2, 3, 2, 24] -> pure Fq12 objects (nested lists)."""
+    flat = jnp.reshape(arr, (-1, 2, 3, 2, L.NLIMBS))
+    fq2s = unpack_fq2(flat.reshape(-1, 2, L.NLIMBS))
+    out = []
+    for i in range(flat.shape[0]):
+        six = fq2s[i * 6:(i + 1) * 6]
+        out.append(pf.Fq12(pf.Fq6(*six[0:3]), pf.Fq6(*six[3:6])))
+    return L.unflatten_list(arr.shape[:-4], out)
+
+
+# --- Fq2 -------------------------------------------------------------------
+
+
+def fq2_add(a, b):
+    return L.fp_add(a, b)
+
+
+def fq2_sub(a, b):
+    return L.fp_sub(a, b)
+
+
+def fq2_neg(a):
+    return L.fp_neg(a)
+
+
+def fq2_mul_small(a, k: int):
+    return L.fp_mul_small(a, k)
+
+
+@jax.jit
+def fq2_conj(a):
+    return jnp.stack([a[..., 0, :], L.fp_neg(a[..., 1, :])], axis=-2)
+
+
+@jax.jit
+def fq2_mul_by_xi(a):
+    """Multiply by xi = 1 + u: (c0 - c1) + (c0 + c1) u."""
+    c0, c1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([L.fp_sub(c0, c1), L.fp_add(c0, c1)], axis=-2)
+
+
+@jax.jit
+def fq2_mul(a, b):
+    """Karatsuba: 3 Fp muls in one stacked call."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    la = jnp.stack([a0, a1, L.fp_add(a0, a1)], axis=-2)
+    lb = jnp.stack([b0, b1, L.fp_add(b0, b1)], axis=-2)
+    t = L.fp_mul(la, lb)
+    t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
+    c0 = L.fp_sub(t0, t1)
+    c1 = L.fp_sub(L.fp_sub(t2, t0), t1)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+@jax.jit
+def fq2_sqr(a):
+    """(a0+a1)(a0-a1), 2*a0*a1 — 2 Fp muls in one stacked call."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    la = jnp.stack([L.fp_add(a0, a1), L.fp_add(a0, a0)], axis=-2)
+    lb = jnp.stack([L.fp_sub(a0, a1), a1], axis=-2)
+    t = L.fp_mul(la, lb)
+    return jnp.stack([t[..., 0, :], t[..., 1, :]], axis=-2)
+
+
+@jax.jit
+def fq2_mul_fp(a, s):
+    """Multiply both coefficients by an Fp scalar s (..., 24)."""
+    return L.fp_mul(a, jnp.stack([s, s], axis=-2))
+
+
+@jax.jit
+def fq2_inv(a):
+    t = L.fp_mul(a, a)  # coefficient axis doubles as the batch axis
+    norm = L.fp_add(t[..., 0, :], t[..., 1, :])
+    d = L.fp_inv(norm)
+    return L.fp_mul(fq2_conj(a), jnp.stack([d, d], axis=-2))
+
+
+def fq2_is_zero(a):
+    return jnp.all(a == 0, axis=(-1, -2))
+
+
+def fq2_eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2))
+
+
+def fq2_select(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def fq2_zero_like(a):
+    return jnp.zeros_like(a)
+
+
+def fq2_one_like(a):
+    one = jnp.zeros_like(a)
+    return one.at[..., 0, :].set(jnp.asarray(L.ONE_MONT))
+
+
+@partial(jax.jit, static_argnums=1)
+def fq2_pow_fixed(a, e: int):
+    bits = L._bits_msb_first(e)
+
+    def body(r, bit):
+        r = fq2_sqr(r)
+        r = fq2_select(jnp.broadcast_to(bit, r.shape[:-2]) == 1,
+                       fq2_mul(r, a), r)
+        return r, None
+
+    r, _ = lax.scan(body, a, jnp.asarray(bits[1:]))
+    return r
+
+
+# --- Fq6 -------------------------------------------------------------------
+
+
+def fq6_add(a, b):
+    return L.fp_add(a, b)
+
+
+def fq6_sub(a, b):
+    return L.fp_sub(a, b)
+
+
+def fq6_neg(a):
+    return L.fp_neg(a)
+
+
+@jax.jit
+def fq6_mul(a, b):
+    """Toom/Karatsuba 6-mul schedule, one stacked fq2_mul call."""
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
+    la = jnp.stack([a0, a1, a2, fq2_add(a1, a2), fq2_add(a0, a1),
+                    fq2_add(a0, a2)], axis=-3)
+    lb = jnp.stack([b0, b1, b2, fq2_add(b1, b2), fq2_add(b0, b1),
+                    fq2_add(b0, b2)], axis=-3)
+    t = fq2_mul(la, lb)
+    t0, t1, t2 = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
+    t12, t01, t02 = t[..., 3, :, :], t[..., 4, :, :], t[..., 5, :, :]
+    c0 = fq2_add(t0, fq2_mul_by_xi(fq2_sub(fq2_sub(t12, t1), t2)))
+    c1 = fq2_add(fq2_sub(fq2_sub(t01, t0), t1), fq2_mul_by_xi(t2))
+    c2 = fq2_add(fq2_sub(fq2_sub(t02, t0), t2), t1)
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+@jax.jit
+def fq6_sqr(a):
+    return fq6_mul(a, a)
+
+
+@jax.jit
+def fq6_mul_by_v(a):
+    """(d0, d1, d2) -> (xi*d2, d0, d1)."""
+    return jnp.stack([fq2_mul_by_xi(a[..., 2, :, :]), a[..., 0, :, :],
+                      a[..., 1, :, :]], axis=-3)
+
+
+@jax.jit
+def fq6_mul_fq2(a, s):
+    """Multiply all three coefficients by an Fq2 scalar."""
+    return fq2_mul(a, jnp.stack([s, s, s], axis=-3))
+
+
+@jax.jit
+def fq6_inv(a):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    # t0 = a0^2 - xi*a1*a2 ; t1 = xi*a2^2 - a0*a1 ; t2 = a1^2 - a0*a2
+    sq = fq2_mul(jnp.stack([a0, a2, a1], axis=-3),
+                 jnp.stack([a0, a2, a1], axis=-3))
+    cr = fq2_mul(jnp.stack([a1, a0, a0], axis=-3),
+                 jnp.stack([a2, a1, a2], axis=-3))
+    s0, s2, s1 = sq[..., 0, :, :], sq[..., 1, :, :], sq[..., 2, :, :]
+    p12, p01, p02 = cr[..., 0, :, :], cr[..., 1, :, :], cr[..., 2, :, :]
+    t0 = fq2_sub(s0, fq2_mul_by_xi(p12))
+    t1 = fq2_sub(fq2_mul_by_xi(s2), p01)
+    t2 = fq2_sub(s1, p02)
+    u = fq2_mul(jnp.stack([a0, a2, a1], axis=-3),
+                jnp.stack([t0, t1, t2], axis=-3))
+    d = fq2_add(u[..., 0, :, :],
+                fq2_mul_by_xi(fq2_add(u[..., 1, :, :], u[..., 2, :, :])))
+    dinv = fq2_inv(d)
+    out = fq2_mul(jnp.stack([t0, t1, t2], axis=-3),
+                  jnp.stack([dinv, dinv, dinv], axis=-3))
+    return out
+
+
+def fq6_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None], a, b)
+
+
+# --- Fq12 ------------------------------------------------------------------
+
+
+def fq12_add(a, b):
+    return L.fp_add(a, b)
+
+
+def fq12_sub(a, b):
+    return L.fp_sub(a, b)
+
+
+@jax.jit
+def fq12_mul(a, b):
+    """Karatsuba over Fq6: 3 Fq6 muls -> one stacked call (54 Fp muls
+    total in a single batched Montgomery multiply)."""
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
+    la = jnp.stack([a0, a1, fq6_add(a0, a1)], axis=-4)
+    lb = jnp.stack([b0, b1, fq6_add(b0, b1)], axis=-4)
+    t = fq6_mul(la, lb)
+    t0, t1, t2 = t[..., 0, :, :, :], t[..., 1, :, :, :], t[..., 2, :, :, :]
+    c0 = fq6_add(t0, fq6_mul_by_v(t1))
+    c1 = fq6_sub(fq6_sub(t2, t0), t1)
+    return jnp.stack([c0, c1], axis=-4)
+
+
+@jax.jit
+def fq12_sqr(a):
+    """Complex-style squaring: 2 Fq6 muls in one stacked call."""
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    la = jnp.stack([fq6_add(a0, a1), a0], axis=-4)
+    lb = jnp.stack([fq6_add(a0, fq6_mul_by_v(a1)), a1], axis=-4)
+    t = fq6_mul(la, lb)
+    t01, t0a1 = t[..., 0, :, :, :], t[..., 1, :, :, :]
+    # t01 = a0^2 + a0*a1*(1+v) + v*a1^2 ; c0 = a0^2 + v a1^2
+    c0 = fq6_sub(fq6_sub(t01, t0a1), fq6_mul_by_v(t0a1))
+    c1 = fq6_add(t0a1, t0a1)
+    return jnp.stack([c0, c1], axis=-4)
+
+
+@jax.jit
+def fq12_conj(a):
+    return jnp.stack([a[..., 0, :, :, :], fq6_neg(a[..., 1, :, :, :])],
+                     axis=-4)
+
+
+@jax.jit
+def fq12_inv(a):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    t = fq6_mul(a, a)  # w-axis doubles as the batch axis
+    d = fq6_sub(t[..., 0, :, :, :], fq6_mul_by_v(t[..., 1, :, :, :]))
+    dinv = fq6_inv(d)
+    out = fq6_mul(jnp.stack([a0, fq6_neg(a1)], axis=-4),
+                  jnp.stack([dinv, dinv], axis=-4))
+    return out
+
+
+def fq12_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None, None], a, b)
+
+
+def fq12_eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2, -3, -4))
+
+
+def fq12_one_like(a):
+    one = jnp.zeros_like(a)
+    return one.at[..., 0, 0, 0, :].set(jnp.asarray(L.ONE_MONT))
+
+
+def fq12_zero_like(a):
+    return jnp.zeros_like(a)
+
+
+@partial(jax.jit, static_argnums=1)
+def fq12_pow_fixed(a, e: int):
+    """a**e for static e via lax.scan (generic square-and-multiply)."""
+    bits = L._bits_msb_first(e)
+
+    def body(r, bit):
+        r = fq12_sqr(r)
+        r = fq12_select(jnp.broadcast_to(bit, r.shape[:-4]) == 1,
+                        fq12_mul(r, a), r)
+        return r, None
+
+    r, _ = lax.scan(body, a, jnp.asarray(bits[1:]))
+    return r
+
+
+# --- Frobenius -------------------------------------------------------------
+
+# gamma constants from the pure model (same tower, so bit-identical):
+# coefficient (h, k) of Fq12 gets Fq2-conjugated then multiplied by
+# GAMMA[h][k] = xi^((p-1)/6)^(h + 2k)  (h in {0,1} over w, k in {0,1,2}
+# over v), mirroring pure.fields._frob12/_frob6.
+_g1 = pf.XI ** ((P - 1) // 6)
+_g2 = _g1 * _g1
+_g4 = _g2 * _g2
+_GAMMA_PURE = [pf.Fq2.one(), _g2, _g4, _g1, _g2 * _g1, _g4 * _g1]
+def _host_mont_fq2(vals) -> np.ndarray:
+    """Pack pure Fq2 values into Montgomery limbs with host-only int
+    math (safe to call inside a jit trace — no jax ops)."""
+    rows = []
+    for v in vals:
+        for c in (v.c0.n, v.c1.n):
+            rows.append(L.int_to_limbs_np((c * L.R_MOD_P) % P))
+    return np.stack(rows).reshape(len(vals), 2, L.NLIMBS)
+
+
+_GAMMA = _host_mont_fq2(_GAMMA_PURE).reshape(2, 3, 2, L.NLIMBS)
+
+
+def _gamma():
+    return jnp.asarray(_GAMMA)
+
+
+@partial(jax.jit, static_argnums=1)
+def fq12_frobenius(a, power: int = 1):
+    """a^(p^power) by repeated single Frobenius (each is one stacked
+    Fq2 mul of batch 6)."""
+    g = _gamma()
+    for _ in range(power % 12):
+        conj = fq2_conj(a)
+        a = fq2_mul(conj, jnp.broadcast_to(g, conj.shape))
+    return a

@@ -14,3 +14,8 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Persistent compilation cache: the limb-arithmetic graphs are big and
+# recompiling them per pytest run would dominate suite time.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/prysm_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")

@@ -1,0 +1,128 @@
+"""Differential tests: XLA tower fields vs the pure golden model."""
+
+import random
+
+import numpy as np
+import pytest
+
+from prysm_tpu.crypto.bls.params import P
+from prysm_tpu.crypto.bls.pure import fields as pf
+from prysm_tpu.crypto.bls.xla import tower as T
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(0x70F3E2)
+
+
+def rand_fq2(rng):
+    return pf.Fq2.from_ints(rng.randrange(P), rng.randrange(P))
+
+
+def rand_fq6(rng):
+    return pf.Fq6(rand_fq2(rng), rand_fq2(rng), rand_fq2(rng))
+
+
+def rand_fq12(rng):
+    return pf.Fq12(rand_fq6(rng), rand_fq6(rng))
+
+
+def pack_fq6(vals):
+    fq2s = [c for v in vals for c in (v.c0, v.c1, v.c2)]
+    return T.pack_fq2(fq2s).reshape(len(vals), 3, 2, -1)
+
+
+def unpack_fq6(arr):
+    flat = T.unpack_fq2(arr.reshape(-1, 2, arr.shape[-1]))
+    return [pf.Fq6(*flat[i:i + 3]) for i in range(0, len(flat), 3)]
+
+
+class TestFq2:
+    N = 8
+
+    def test_mul(self, rng):
+        xs = [rand_fq2(rng) for _ in range(self.N)]
+        ys = [rand_fq2(rng) for _ in range(self.N)]
+        got = T.unpack_fq2(T.fq2_mul(T.pack_fq2(xs), T.pack_fq2(ys)))
+        assert got == [x * y for x, y in zip(xs, ys)]
+
+    def test_sqr(self, rng):
+        xs = [rand_fq2(rng) for _ in range(self.N)]
+        got = T.unpack_fq2(T.fq2_sqr(T.pack_fq2(xs)))
+        assert got == [x * x for x in xs]
+
+    def test_add_sub_neg_conj_xi(self, rng):
+        xs = [rand_fq2(rng) for _ in range(self.N)]
+        ys = [rand_fq2(rng) for _ in range(self.N)]
+        a, b = T.pack_fq2(xs), T.pack_fq2(ys)
+        assert T.unpack_fq2(T.fq2_add(a, b)) == [x + y for x, y in
+                                                 zip(xs, ys)]
+        assert T.unpack_fq2(T.fq2_sub(a, b)) == [x - y for x, y in
+                                                 zip(xs, ys)]
+        assert T.unpack_fq2(T.fq2_neg(a)) == [-x for x in xs]
+        assert T.unpack_fq2(T.fq2_conj(a)) == [x.conjugate() for x in xs]
+        assert T.unpack_fq2(T.fq2_mul_by_xi(a)) == [x.mul_by_nonresidue()
+                                                    for x in xs]
+
+    def test_inv(self, rng):
+        xs = [rand_fq2(rng) for _ in range(2)]
+        got = T.unpack_fq2(T.fq2_inv(T.pack_fq2(xs)))
+        assert got == [x.inv() for x in xs]
+
+
+class TestFq6:
+    N = 4
+
+    def test_mul(self, rng):
+        xs = [rand_fq6(rng) for _ in range(self.N)]
+        ys = [rand_fq6(rng) for _ in range(self.N)]
+        got = unpack_fq6(T.fq6_mul(pack_fq6(xs), pack_fq6(ys)))
+        assert got == [x * y for x, y in zip(xs, ys)]
+
+    def test_mul_by_v(self, rng):
+        xs = [rand_fq6(rng) for _ in range(self.N)]
+        got = unpack_fq6(T.fq6_mul_by_v(pack_fq6(xs)))
+        assert got == [x.mul_by_v() for x in xs]
+
+    def test_inv(self, rng):
+        xs = [rand_fq6(rng) for _ in range(2)]
+        got = unpack_fq6(T.fq6_inv(pack_fq6(xs)))
+        assert got == [x.inv() for x in xs]
+
+
+class TestFq12:
+    N = 2
+
+    def test_mul(self, rng):
+        xs = [rand_fq12(rng) for _ in range(self.N)]
+        ys = [rand_fq12(rng) for _ in range(self.N)]
+        got = T.unpack_fq12(T.fq12_mul(T.pack_fq12(xs), T.pack_fq12(ys)))
+        assert got == [x * y for x, y in zip(xs, ys)]
+
+    def test_sqr(self, rng):
+        xs = [rand_fq12(rng) for _ in range(self.N)]
+        got = T.unpack_fq12(T.fq12_sqr(T.pack_fq12(xs)))
+        assert got == [x * x for x in xs]
+
+    def test_conj_inv(self, rng):
+        xs = [rand_fq12(rng) for _ in range(self.N)]
+        a = T.pack_fq12(xs)
+        assert T.unpack_fq12(T.fq12_conj(a)) == [x.conjugate() for x in xs]
+        assert T.unpack_fq12(T.fq12_inv(a)) == [x.inv() for x in xs]
+
+    def test_frobenius(self, rng):
+        xs = [rand_fq12(rng) for _ in range(self.N)]
+        a = T.pack_fq12(xs)
+        for power in (1, 2, 3, 6):
+            got = T.unpack_fq12(T.fq12_frobenius(a, power))
+            assert got == [pf.fq12_frobenius(x, power) for x in xs], power
+
+    def test_pow_small(self, rng):
+        xs = [rand_fq12(rng)]
+        e = rng.randrange(1, 1 << 64)
+        got = T.unpack_fq12(T.fq12_pow_fixed(T.pack_fq12(xs), e))
+        assert got == [x ** e for x in xs]
+
+    def test_one(self, rng):
+        a = T.pack_fq12([rand_fq12(rng)])
+        assert T.unpack_fq12(T.fq12_one_like(a)) == [pf.Fq12.one()]
