@@ -1,0 +1,154 @@
+"""Benchmark of record (driver contract): prints ONE JSON line.
+
+Runs on the real TPU chip (do not force JAX_PLATFORMS=cpu here).
+Implements the highest BASELINE.json config available in the current
+state of the framework and reports the metric of record
+(BLS sigs/sec/chip once the verify path exists; field-op throughput
+as the interim bottom tier).
+
+BASELINE configs (BASELINE.md):
+  1. single verify          -> tier "single_verify"     (available)
+  2. aggregate verify 1x128 -> tier "aggregate_verify"  (available)
+  3. full slot 64x200       -> tier "slot_verify"       (available)
+  4. 500k-validator HTR     -> tier "htr_registry"      (available)
+  5. epoch replay           -> tier "epoch_replay"      (pending)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/prysm_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+
+def _timeit(fn, *args, warmup: int = 2, iters: int = 5):
+    """Median wall time of fn(*args) after warmup; blocks on device."""
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def bench_slot_verify():
+    """BASELINE config #3: full-slot SignatureBatch, 64 committees x
+    200 attesters, one device dispatch.  Metric of record."""
+    from prysm_tpu.crypto.bls import bls
+
+    batch = bls.build_synthetic_slot_batch(n_committees=64,
+                                           committee_size=200)
+    fn, args = bls.compiled_slot_verify(batch)
+    t = _timeit(fn, *args)
+    n_sigs = 64 * 200
+    return {
+        "metric": "full_slot_attestation_verify_p50",
+        "value": round(t * 1e3, 3),
+        "unit": "ms/slot (64x200 sigs; sigs/sec/chip=%d)" % int(n_sigs / t),
+        # north star: < 5 ms/slot on one chip -> ratio target/actual
+        "vs_baseline": round(5e-3 / t, 4),
+    }
+
+
+def bench_aggregate_verify():
+    """BASELINE config #2: 1 committee, 128 validators, 1 root."""
+    from prysm_tpu.crypto.bls import bls
+
+    fn, args = bls.compiled_fast_aggregate_verify(n_pubkeys=128)
+    t = _timeit(fn, *args)
+    return {
+        "metric": "fast_aggregate_verify_128",
+        "value": round(t * 1e3, 3),
+        "unit": "ms/verify (128 pubkeys, 1 msg)",
+        # CPU blst: ~1 pairing-bound verify ~0.5-1.0 ms [BASELINE.md]
+        "vs_baseline": round(1.0e-3 / t, 4),
+    }
+
+
+def bench_single_verify():
+    """BASELINE config #1: single sig verify."""
+    from prysm_tpu.crypto.bls import bls
+
+    fn, args = bls.compiled_single_verify()
+    t = _timeit(fn, *args)
+    return {
+        "metric": "single_bls_verify",
+        "value": round(t * 1e3, 3),
+        "unit": "ms/verify",
+        # CPU blst single verify ~0.4-1.0 ms [BASELINE.md]; use 0.7 ms
+        "vs_baseline": round(0.7e-3 / t, 4),
+    }
+
+
+def bench_htr_registry():
+    """BASELINE config #4: 500k-validator registry hash-tree-root."""
+    from prysm_tpu.ssz import merkle_jax
+
+    fn, args = merkle_jax.compiled_registry_root(n_validators=500_000)
+    t = _timeit(fn, *args, warmup=1, iters=3)
+    return {
+        "metric": "validator_registry_htr_500k",
+        "value": round(t * 1e3, 3),
+        "unit": "ms/root (500k validators)",
+        # CPU cold full Merkleize ~1-3 s [BASELINE.md]; use 2 s
+        "vs_baseline": round(2.0 / t, 4),
+    }
+
+
+def bench_field_throughput():
+    """Bottom tier: batched Fq12 Montgomery multiply throughput —
+    reported only until the verify tiers exist."""
+    import jax
+    import jax.numpy as jnp
+
+    from prysm_tpu.crypto.bls.xla import limbs as L, tower as T
+
+    batch = 8192
+    key = jax.random.PRNGKey(0)
+    a = jax.random.randint(key, (batch, 2, 3, 2, L.NLIMBS), 0, 1 << 16,
+                           dtype=jnp.int32).astype(jnp.uint32)
+    # keep the top limb below P's top limb so values are canonical
+    a = a.at[..., -1].set(a[..., -1] & jnp.uint32(0x19FF))
+    fn = jax.jit(T.fq12_mul)
+    t = _timeit(fn, a, a)
+    return {
+        "metric": "fq12_mul_throughput",
+        "value": round(batch / t, 1),
+        "unit": "fq12_mul/sec (batch 8192)",
+        "vs_baseline": 0.0,
+    }
+
+
+TIERS = [
+    ("slot_verify", bench_slot_verify),
+    ("aggregate_verify", bench_aggregate_verify),
+    ("single_verify", bench_single_verify),
+    ("field_throughput", bench_field_throughput),
+]
+
+
+def main() -> None:
+    last_err = None
+    for name, fn in TIERS:
+        try:
+            result = fn()
+            print(json.dumps(result))
+            return
+        except Exception as e:  # noqa: BLE001 - fall through to next tier
+            last_err = (name, repr(e))
+            print(f"# tier {name} unavailable: {e!r}", file=sys.stderr)
+    print(json.dumps({"metric": "error", "value": 0, "unit": str(last_err),
+                      "vs_baseline": 0}))
+
+
+if __name__ == "__main__":
+    main()

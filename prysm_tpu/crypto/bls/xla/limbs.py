@@ -67,8 +67,9 @@ def limbs_to_int(limbs) -> int:
 
 
 P_LIMBS = int_to_limbs_np(P)
-# -P^{-1} mod 2**16 (the Montgomery n0' constant for the lowest limb)
-N0 = np.uint32((-pow(P, -1, RADIX)) % RADIX)
+# -P^{-1} mod 2**384 (full-width Montgomery inverse, for product-form
+# reduction: M = T*NPRIME mod R, result = (T + M*P)/R)
+NPRIME_LIMBS = int_to_limbs_np((-pow(P, -1, 1 << NBITS)) % (1 << NBITS))
 R_MOD_P = (1 << NBITS) % P
 R2_MOD_P = pow(1 << NBITS, 2, P)
 ONE_MONT = int_to_limbs_np(R_MOD_P)        # 1 in Montgomery form
@@ -80,26 +81,34 @@ ZERO = np.zeros(NLIMBS, dtype=np.uint32)
 
 def _carry_norm(cols, n_out: int):
     """Ripple-carry a redundant column vector (entries < 2**26) into
-    canonical 16-bit limbs.  Returns uint32[..., n_out]; any carry out
-    of the top requested limb is dropped (callers guarantee it is 0)."""
-    carry = jnp.zeros(cols.shape[:-1], dtype=jnp.uint32)
-    outs = []
-    for i in range(n_out):
-        v = cols[..., i] + carry
-        outs.append(v & MASK32)
-        carry = v >> RADIX_BITS
-    return jnp.stack(outs, axis=-1)
+    canonical 16-bit limbs via lax.scan over the limb axis.  Returns
+    uint32[..., n_out]; the carry out of the top requested limb is
+    dropped — i.e. the result is reduced mod 2**(16*n_out).  Callers
+    either guarantee the carry is zero (values known < 2**384) or rely
+    on the wrap (fp_sub's +P correction, _mont_reduce's t_lo mod R)."""
+    xs = jnp.moveaxis(cols[..., :n_out], -1, 0)
+
+    def body(carry, col):
+        v = col + carry
+        return v >> RADIX_BITS, v & MASK32
+
+    # derive the init from the operand so its sharding/varying axes
+    # match under shard_map (a fresh constant would not)
+    _, outs = lax.scan(body, cols[..., 0] & jnp.uint32(0), xs)
+    return jnp.moveaxis(outs, 0, -1)
 
 
 def _sub_borrow(a, b_limbs):
     """a - b over 24 limbs; returns (diff mod 2**384, borrow in {0,1})."""
-    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
-    outs = []
-    for i in range(NLIMBS):
-        d = a[..., i] + np.uint32(RADIX) - b_limbs[..., i] - borrow
-        outs.append(d & MASK32)
-        borrow = jnp.uint32(1) - (d >> RADIX_BITS)
-    return jnp.stack(outs, axis=-1), borrow
+    xs = jnp.moveaxis(jnp.stack(
+        [a, jnp.broadcast_to(b_limbs, a.shape)], axis=0), -1, 0)
+
+    def body(borrow, ab):
+        d = ab[0] + np.uint32(RADIX) - ab[1] - borrow
+        return jnp.uint32(1) - (d >> RADIX_BITS), d & MASK32
+
+    borrow, outs = lax.scan(body, a[..., 0] & jnp.uint32(0), xs)
+    return jnp.moveaxis(outs, 0, -1), borrow
 
 
 def _add_limbs_mod_2_384(a, b_limbs):
@@ -149,32 +158,57 @@ def fp_mul_small(a, k: int):
     return out
 
 
-def _mul_columns(a, b):
-    """Full 768-bit schoolbook product as 49 redundant columns."""
+def _shift_pad(x, off: int, width: int):
+    """Place x (..., 24) at column offset ``off`` in a width-column
+    vector via pad (concat — cheaper than scatter on TPU)."""
+    pads = [(0, 0)] * (x.ndim - 1) + [(off, width - off - NLIMBS)]
+    return jnp.pad(x, pads)
+
+
+def _mul_columns(a, b, low_only: bool = False):
+    """Schoolbook product as redundant columns: 48 columns for the full
+    768-bit product, or 24 columns of the low half (mod 2**384)."""
     prods = a[..., :, None] * b[..., None, :]          # (..., 24, 24) u32
     lo = prods & MASK32
     hi = prods >> RADIX_BITS
-    cols = jnp.zeros(prods.shape[:-2] + (2 * NLIMBS + 1,), dtype=jnp.uint32)
+    width = NLIMBS if low_only else 2 * NLIMBS
+    cols = jnp.zeros(prods.shape[:-2] + (width,), dtype=jnp.uint32)
     for i in range(NLIMBS):
-        cols = cols.at[..., i:i + NLIMBS].add(lo[..., i, :])
-        cols = cols.at[..., i + 1:i + NLIMBS + 1].add(hi[..., i, :])
+        if low_only:
+            cols = cols + _shift_pad_trim(lo[..., i, :], i, width)
+            if i + 1 < NLIMBS:
+                cols = cols + _shift_pad_trim(hi[..., i, :], i + 1, width)
+        else:
+            cols = cols + _shift_pad(lo[..., i, :], i, width)
+            cols = cols + _shift_pad(hi[..., i, :], i + 1, width)
     return cols
 
 
-def _mont_reduce(cols):
-    """Montgomery-reduce 49 redundant columns -> canonical 24 limbs.
+def _shift_pad_trim(x, off: int, width: int):
+    """_shift_pad, truncating entries that fall past ``width``."""
+    keep = min(x.shape[-1], width - off)
+    pads = [(0, 0)] * (x.ndim - 1) + [(off, width - off - keep)]
+    return jnp.pad(x[..., :keep], pads)
 
-    Column i's low 16 bits are exact at step i (see module docstring),
-    so m_i needs no prior carry normalization."""
-    p = jnp.asarray(P_LIMBS)
-    for i in range(NLIMBS):
-        ti = cols[..., i]
-        m = ((ti & MASK32) * N0) & MASK32
-        mp = m[..., None] * p                           # (..., 24)
-        cols = cols.at[..., i:i + NLIMBS].add(mp & MASK32)
-        cols = cols.at[..., i + 1:i + NLIMBS + 1].add(mp >> RADIX_BITS)
-        cols = cols.at[..., i + 1].add(cols[..., i] >> RADIX_BITS)
-    limbs = _carry_norm(cols[..., NLIMBS:], NLIMBS)
+
+def _mul_low(a, b):
+    """Exact low 384 bits of a*b (canonical 16-bit limbs)."""
+    return _carry_norm(_mul_columns(a, b, low_only=True), NLIMBS)
+
+
+def _mont_reduce(cols):
+    """Montgomery-reduce 48 redundant product columns -> canonical 24
+    limbs, in product form: M = (T mod R) * (-P^-1 mod R) mod R, then
+    result = (T + M*P) / R.  Two big vectorized multiplies instead of a
+    24-step sequential loop — far better for XLA compile time and TPU
+    vectorization than interleaved CIOS."""
+    t_lo = _carry_norm(cols[..., :NLIMBS], NLIMBS)
+    m = _mul_low(t_lo, jnp.asarray(NPRIME_LIMBS))
+    mp = _mul_columns(m, jnp.broadcast_to(jnp.asarray(P_LIMBS), m.shape))
+    total = cols + mp                    # entries < 2**24: still safe
+    # low 24 columns of (T + M*P) are == 0 mod 2**384 by construction;
+    # normalize the full 48 so their carries flow into the high half.
+    limbs = _carry_norm(total, 2 * NLIMBS)[..., NLIMBS:]
     return _csub_p(limbs)
 
 

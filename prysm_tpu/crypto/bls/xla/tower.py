@@ -64,10 +64,23 @@ def pack_fq12(values, mont: bool = True) -> jnp.ndarray:
     return arr.reshape(len(values), 2, 3, 2, L.NLIMBS)
 
 
+def pack_fq6(values, mont: bool = True) -> jnp.ndarray:
+    """List of pure Fq6 -> uint32[n, 3, 2, 24]."""
+    fq2s = [c for v in values for c in (v.c0, v.c1, v.c2)]
+    return pack_fq2(fq2s, mont=mont).reshape(len(values), 3, 2, L.NLIMBS)
+
+
+def unpack_fq6(arr, mont: bool = True):
+    """uint32[..., 3, 2, 24] -> pure Fq6 objects (nested lists)."""
+    flat = unpack_fq2(jnp.reshape(arr, (-1, 2, L.NLIMBS)), mont=mont)
+    out = [pf.Fq6(*flat[i:i + 3]) for i in range(0, len(flat), 3)]
+    return L.unflatten_list(arr.shape[:-3], out)
+
+
 def unpack_fq12(arr, mont: bool = True):
     """uint32[..., 2, 3, 2, 24] -> pure Fq12 objects (nested lists)."""
     flat = jnp.reshape(arr, (-1, 2, 3, 2, L.NLIMBS))
-    fq2s = unpack_fq2(flat.reshape(-1, 2, L.NLIMBS))
+    fq2s = unpack_fq2(flat.reshape(-1, 2, L.NLIMBS), mont=mont)
     out = []
     for i in range(flat.shape[0]):
         six = fq2s[i * 6:(i + 1) * 6]

@@ -1,13 +1,17 @@
 """Force tests onto a virtual 8-device CPU mesh.
 
 The real TPU (1 chip) is reserved for bench.py; unit tests exercise
-sharding on a virtual CPU mesh per the driver contract. Must run before
-jax is imported anywhere.
+sharding on a virtual CPU mesh per the driver contract.
+
+NOTE: this image's axon sitecustomize pins the TPU platform in a way
+that overrides the JAX_PLATFORMS *env var*, so we must also call
+``jax.config.update('jax_platforms', 'cpu')`` — env alone silently
+leaves tests on the TPU.  XLA_FLAGS must still be set before the CPU
+backend initializes to get 8 virtual devices.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,3 +23,9 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       "/tmp/prysm_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax  # noqa: E402  (after env setup, before any test imports)
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu"
+assert len(jax.devices()) == 8, jax.devices()

@@ -27,14 +27,8 @@ def rand_fq12(rng):
     return pf.Fq12(rand_fq6(rng), rand_fq6(rng))
 
 
-def pack_fq6(vals):
-    fq2s = [c for v in vals for c in (v.c0, v.c1, v.c2)]
-    return T.pack_fq2(fq2s).reshape(len(vals), 3, 2, -1)
-
-
-def unpack_fq6(arr):
-    flat = T.unpack_fq2(arr.reshape(-1, 2, arr.shape[-1]))
-    return [pf.Fq6(*flat[i:i + 3]) for i in range(0, len(flat), 3)]
+pack_fq6 = T.pack_fq6
+unpack_fq6 = T.unpack_fq6
 
 
 class TestFq2:
