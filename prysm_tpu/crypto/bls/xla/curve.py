@@ -1,0 +1,304 @@
+"""Jacobian elliptic-curve arithmetic for BLS12-381 G1/G2 on JAX limbs.
+
+Reference analog: blst's G1/G2 point ops + scalar multiplication
+(crypto/bls L0 [U, SURVEY.md §2.1.1]).  TPU-first design notes:
+
+* Points are (X, Y, Z) Jacobian triples of field arrays; infinity is
+  Z == 0.  All formulas are branchless — edge cases (P==Q, P==-Q,
+  either infinity) resolve via selects, so everything jits and vmaps.
+* The field is pluggable: ``FpOps``/``Fq2Ops`` adapt the limb and
+  tower modules, so one implementation serves E1(Fq) and E2'(Fq2).
+* Scalar multiplication runs as a lax.scan over a fixed bit count
+  (double-always, add-by-select) — constant trace size, batchable,
+  per-element scalars.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..params import (
+    G1_X, G1_Y, G2_X_C0, G2_X_C1, G2_Y_C0, G2_Y_C1, P, R,
+)
+from ..pure import fields as pf
+from . import limbs as L
+from . import tower as T
+
+
+class FieldOps(NamedTuple):
+    mul: object
+    sqr: object
+    add: object
+    sub: object
+    neg: object
+    mul_small: object
+    is_zero: object
+    select: object
+    inv: object
+    ndims: int  # trailing dims of one element (1 for Fp, 2 for Fq2)
+
+
+FP_OPS = FieldOps(
+    mul=L.fp_mul, sqr=L.fp_sqr, add=L.fp_add, sub=L.fp_sub, neg=L.fp_neg,
+    mul_small=L.fp_mul_small, is_zero=L.fp_is_zero, select=L.fp_select,
+    inv=L.fp_inv, ndims=1,
+)
+
+FQ2_OPS = FieldOps(
+    mul=T.fq2_mul, sqr=T.fq2_sqr, add=T.fq2_add, sub=T.fq2_sub,
+    neg=T.fq2_neg, mul_small=T.fq2_mul_small, is_zero=T.fq2_is_zero,
+    select=T.fq2_select, inv=T.fq2_inv, ndims=2,
+)
+
+
+# --- point algebra (generic over the field) --------------------------------
+
+
+def point_double(ops: FieldOps, pt):
+    """dbl-2009-l (a=0).  Infinity (Z=0) stays infinity (Z3=2YZ=0)."""
+    X, Y, Z = pt
+    A = ops.sqr(X)
+    B = ops.sqr(Y)
+    C = ops.sqr(B)
+    t = ops.sqr(ops.add(X, B))
+    D = ops.mul_small(ops.sub(ops.sub(t, A), C), 2)
+    E = ops.mul_small(A, 3)
+    F = ops.sqr(E)
+    X3 = ops.sub(F, ops.mul_small(D, 2))
+    Y3 = ops.sub(ops.mul(E, ops.sub(D, X3)), ops.mul_small(C, 8))
+    Z3 = ops.mul_small(ops.mul(Y, Z), 2)
+    return (X3, Y3, Z3)
+
+
+def point_add(ops: FieldOps, p1, p2):
+    """add-2007-bl with branchless edge handling.
+
+    H==0, r!=0 (P == -Q) yields Z3 = 0 — infinity — for free;
+    H==0, r==0 (P == Q) selects the doubling; either input at
+    infinity selects the other operand."""
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    Z1Z1 = ops.sqr(Z1)
+    Z2Z2 = ops.sqr(Z2)
+    U1 = ops.mul(X1, Z2Z2)
+    U2 = ops.mul(X2, Z1Z1)
+    S1 = ops.mul(ops.mul(Y1, Z2), Z2Z2)
+    S2 = ops.mul(ops.mul(Y2, Z1), Z1Z1)
+    H = ops.sub(U2, U1)
+    I = ops.sqr(ops.mul_small(H, 2))
+    J = ops.mul(H, I)
+    r = ops.mul_small(ops.sub(S2, S1), 2)
+    V = ops.mul(U1, I)
+    X3 = ops.sub(ops.sub(ops.sqr(r), J), ops.mul_small(V, 2))
+    Y3 = ops.sub(ops.mul(r, ops.sub(V, X3)),
+                 ops.mul_small(ops.mul(S1, J), 2))
+    Z3 = ops.mul(ops.mul_small(ops.mul(Z1, Z2), 2), H)
+    out = (X3, Y3, Z3)
+
+    same_x = ops.is_zero(H)
+    same_y = ops.is_zero(ops.sub(S2, S1))
+    dbl = point_double(ops, p1)
+    is_dbl = same_x & same_y
+    out = tuple(ops.select(is_dbl, d, o) for d, o in zip(dbl, out))
+
+    p1_inf = ops.is_zero(Z1)
+    p2_inf = ops.is_zero(Z2)
+    out = tuple(ops.select(p1_inf, b, o) for b, o in zip(p2, out))
+    # note: p1_inf wins only if p2 not-inf is fine; if both inf, Z=0 ok
+    out = tuple(ops.select(p2_inf & ~p1_inf, a, o)
+                for a, o in zip(p1, out))
+    return out
+
+
+def point_neg(ops: FieldOps, pt):
+    X, Y, Z = pt
+    return (X, ops.neg(Y), Z)
+
+
+def point_select(ops: FieldOps, cond, p1, p2):
+    return tuple(ops.select(cond, a, b) for a, b in zip(p1, p2))
+
+
+def point_is_inf(ops: FieldOps, pt):
+    return ops.is_zero(pt[2])
+
+
+def scalar_mul(ops: FieldOps, pt, scalar_bits):
+    """Double-always / add-by-select over a fixed bit count.
+
+    scalar_bits: uint32[nbits, ...] MSB-first, batch dims matching the
+    point's batch dims.  Runs as one lax.scan — constant trace size."""
+
+    def body(acc, bit):
+        acc = point_double(ops, acc)
+        added = point_add(ops, acc, pt)
+        sel = bit == 1
+        acc = point_select(ops, sel, added, acc)
+        return acc, None
+
+    inf = point_inf_like(ops, pt)
+    out, _ = lax.scan(body, inf, scalar_bits)
+    return out
+
+
+def point_inf_like(ops: FieldOps, pt):
+    """(1, 1, 0) in Montgomery form, shaped/sharded like pt (built from
+    the operand so varying axes survive shard_map)."""
+    one_np = np.zeros((2,) * (ops.ndims - 1) + (L.NLIMBS,), np.uint32)
+    one_np[(0,) * (ops.ndims - 1)] = L.ONE_MONT
+    one = (pt[0] & jnp.uint32(0)) + jnp.asarray(one_np)
+    zero = pt[2] & jnp.uint32(0)
+    return (one, one, zero)
+
+
+def scalar_bits_from_ints(scalars, nbits: int) -> jnp.ndarray:
+    """Python ints -> uint32[nbits, n] MSB-first bit planes."""
+    arr = np.zeros((nbits, len(scalars)), dtype=np.uint32)
+    for j, s in enumerate(scalars):
+        if s < 0 or s >> nbits:
+            raise ValueError("scalar out of range")
+        for i in range(nbits):
+            arr[i, j] = (s >> (nbits - 1 - i)) & 1
+    return jnp.asarray(arr)
+
+
+# --- host <-> device point conversion --------------------------------------
+
+
+def pack_g1_points(pts) -> tuple:
+    """Affine pure points [(Fq, Fq) or None] -> Jacobian device triple
+    with batch shape (n,)."""
+    xs, ys, zs = [], [], []
+    for pt in pts:
+        if pt is None:
+            xs.append(1)
+            ys.append(1)
+            zs.append(0)
+        else:
+            xs.append(pt[0].n)
+            ys.append(pt[1].n)
+            zs.append(1)
+    return (L.pack_ints(xs), L.pack_ints(ys), L.pack_ints(zs))
+
+
+def pack_g2_points(pts) -> tuple:
+    xs, ys, zs = [], [], []
+    for pt in pts:
+        if pt is None:
+            xs.append(pf.Fq2.one())
+            ys.append(pf.Fq2.one())
+            zs.append(pf.Fq2.zero())
+        else:
+            xs.append(pt[0])
+            ys.append(pt[1])
+            zs.append(pf.Fq2.one())
+    return (T.pack_fq2(xs), T.pack_fq2(ys), T.pack_fq2(zs))
+
+
+@jax.jit
+def g1_to_affine(pt):
+    """Jacobian -> affine (x, y, is_inf) on device."""
+    X, Y, Z = pt
+    zinv = L.fp_inv(Z)
+    zinv2 = L.fp_sqr(zinv)
+    x = L.fp_mul(X, zinv2)
+    y = L.fp_mul(Y, L.fp_mul(zinv2, zinv))
+    return x, y, L.fp_is_zero(Z)
+
+
+@jax.jit
+def g2_to_affine(pt):
+    X, Y, Z = pt
+    zinv = T.fq2_inv(Z)
+    zinv2 = T.fq2_sqr(zinv)
+    x = T.fq2_mul(X, zinv2)
+    y = T.fq2_mul(Y, T.fq2_mul(zinv2, zinv))
+    return x, y, T.fq2_is_zero(Z)
+
+
+def unpack_g1_points(pt):
+    """Jacobian device triple -> affine pure points (None for inf)."""
+    x, y, inf = g1_to_affine(pt)
+    xi = L.unpack_ints(x)
+    yi = L.unpack_ints(y)
+    infs = np.asarray(inf).reshape(-1).tolist()
+    if not isinstance(xi, list):
+        xi, yi = [xi], [yi]
+    out = []
+    for a, b, z in zip(_flatten(xi), _flatten(yi), infs):
+        out.append(None if z else (pf.Fq(a), pf.Fq(b)))
+    return out
+
+
+def unpack_g2_points(pt):
+    x, y, inf = g2_to_affine(pt)
+    xq = T.unpack_fq2(x)
+    yq = T.unpack_fq2(y)
+    infs = np.asarray(inf).reshape(-1).tolist()
+    if not isinstance(xq, list):
+        xq, yq = [xq], [yq]
+    out = []
+    for a, b, z in zip(_flatten(xq), _flatten(yq), infs):
+        out.append(None if z else (a, b))
+    return out
+
+
+def _flatten(nested):
+    if not isinstance(nested, list):
+        return [nested]
+    out = []
+    for item in nested:
+        out.extend(_flatten(item))
+    return out
+
+
+# --- batched reductions ----------------------------------------------------
+
+
+def point_sum_tree(ops: FieldOps, pt, axis_size: int):
+    """Sum a batch of points along the leading batch axis by halving
+    (log2 rounds of one batched add each)."""
+    X, Y, Z = pt
+    n = axis_size
+    while n > 1:
+        half = (n + 1) // 2
+        if n % 2 == 1:
+            pad = point_inf_like(ops, (X[:1], Y[:1], Z[:1]))
+            X = jnp.concatenate([X, pad[0]], axis=0)
+            Y = jnp.concatenate([Y, pad[1]], axis=0)
+            Z = jnp.concatenate([Z, pad[2]], axis=0)
+        a = (X[:half], Y[:half], Z[:half])
+        b = (X[half:2 * half], Y[half:2 * half], Z[half:2 * half])
+        X, Y, Z = point_add(ops, a, b)
+        n = half
+    return (X[0], Y[0], Z[0])
+
+
+# --- jitted top-level helpers ----------------------------------------------
+
+g1_double = jax.jit(partial(point_double, FP_OPS))
+g2_double = jax.jit(partial(point_double, FQ2_OPS))
+g1_add = jax.jit(partial(point_add, FP_OPS))
+g2_add = jax.jit(partial(point_add, FQ2_OPS))
+g1_scalar_mul = jax.jit(partial(scalar_mul, FP_OPS))
+g2_scalar_mul = jax.jit(partial(scalar_mul, FQ2_OPS))
+
+
+def g1_generator(batch: int = 1):
+    return pack_g1_points([(pf.Fq(G1_X), pf.Fq(G1_Y))] * batch)
+
+
+def g2_generator(batch: int = 1):
+    gx = pf.Fq2.from_ints(G2_X_C0, G2_X_C1)
+    gy = pf.Fq2.from_ints(G2_Y_C0, G2_Y_C1)
+    return pack_g2_points([(gx, gy)] * batch)
+
+
+R_BITS = R.bit_length()  # 255

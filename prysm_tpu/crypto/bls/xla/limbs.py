@@ -286,6 +286,18 @@ def fp_inv(a):
 # --- host <-> device conversion -------------------------------------------
 
 
+def rand_canonical(seed: int, shape) -> jnp.ndarray:
+    """Uniform-ish canonical field elements (< P) for benchmarks and
+    smoke tests: random 16-bit limbs with the top limb masked below
+    P's top limb (derived, not hard-coded)."""
+    top = int(P_LIMBS[-1])
+    top_mask = (1 << (top.bit_length() - 1)) - 1  # strictly below top
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, RADIX, tuple(shape) + (NLIMBS,), dtype=np.uint32)
+    arr[..., -1] &= top_mask
+    return jnp.asarray(arr)
+
+
 def pack_ints(values, mont: bool = True) -> jnp.ndarray:
     """List/array of Python ints -> uint32[n, 24] (Montgomery by default)."""
     arr = np.stack([int_to_limbs_np(v % P) for v in values])

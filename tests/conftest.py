@@ -12,11 +12,13 @@ backend initializes to get 8 virtual devices.
 
 import os
 
+import re as _re
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8"
+).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 # Persistent compilation cache: the limb-arithmetic graphs are big and
 # recompiling them per pytest run would dominate suite time.

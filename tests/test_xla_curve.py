@@ -1,0 +1,98 @@
+"""Differential tests: XLA Jacobian curve ops vs the pure golden model."""
+
+import random
+
+import numpy as np
+import pytest
+
+from prysm_tpu.crypto.bls.params import R
+from prysm_tpu.crypto.bls.pure import curve as pc
+from prysm_tpu.crypto.bls.xla import curve as C
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(0xC04F)
+
+
+def rand_g1(rng, n):
+    return [pc.multiply(pc.G1_GEN, rng.randrange(1, R)) for _ in range(n)]
+
+
+def rand_g2(rng, n):
+    return [pc.multiply(pc.G2_GEN, rng.randrange(1, R)) for _ in range(n)]
+
+
+class TestG1:
+    def test_double_add(self, rng):
+        pts = rand_g1(rng, 4)
+        qts = rand_g1(rng, 4)
+        dev_p = C.pack_g1_points(pts)
+        dev_q = C.pack_g1_points(qts)
+        got_dbl = C.unpack_g1_points(C.g1_double(dev_p))
+        assert got_dbl == [pc.double(p) for p in pts]
+        got_add = C.unpack_g1_points(C.g1_add(dev_p, dev_q))
+        assert got_add == [pc.add(p, q) for p, q in zip(pts, qts)]
+
+    def test_add_edge_cases(self, rng):
+        p = rand_g1(rng, 1)[0]
+        cases_a = [p, p, None, p, None]
+        cases_b = [p, pc.neg(p), p, None, None]
+        want = [pc.add(a, b) for a, b in zip(cases_a, cases_b)]
+        got = C.unpack_g1_points(
+            C.g1_add(C.pack_g1_points(cases_a), C.pack_g1_points(cases_b)))
+        assert got == want
+
+    def test_scalar_mul(self, rng):
+        pts = rand_g1(rng, 3)
+        ks = [rng.randrange(R) for _ in range(3)]
+        bits = C.scalar_bits_from_ints(ks, C.R_BITS)
+        got = C.unpack_g1_points(
+            C.g1_scalar_mul(C.pack_g1_points(pts), bits))
+        assert got == [pc.multiply(p, k) for p, k in zip(pts, ks)]
+
+    def test_scalar_mul_zero_and_one(self, rng):
+        p = rand_g1(rng, 1)[0]
+        bits = C.scalar_bits_from_ints([0, 1], C.R_BITS)
+        got = C.unpack_g1_points(
+            C.g1_scalar_mul(C.pack_g1_points([p, p]), bits))
+        assert got == [None, p]
+
+    def test_sum_tree(self, rng):
+        pts = rand_g1(rng, 5)
+        dev = C.pack_g1_points(pts)
+        total = C.point_sum_tree(C.FP_OPS, dev, 5)
+        got = C.unpack_g1_points(tuple(t[None] for t in total))
+        want = None
+        for p in pts:
+            want = pc.add(want, p)
+        assert got == [want]
+
+
+class TestG2:
+    def test_double_add(self, rng):
+        pts = rand_g2(rng, 2)
+        qts = rand_g2(rng, 2)
+        got_dbl = C.unpack_g2_points(C.g2_double(C.pack_g2_points(pts)))
+        assert got_dbl == [pc.double(p) for p in pts]
+        got_add = C.unpack_g2_points(
+            C.g2_add(C.pack_g2_points(pts), C.pack_g2_points(qts)))
+        assert got_add == [pc.add(p, q) for p, q in zip(pts, qts)]
+
+    def test_scalar_mul(self, rng):
+        pts = rand_g2(rng, 2)
+        ks = [rng.randrange(R) for _ in range(2)]
+        bits = C.scalar_bits_from_ints(ks, C.R_BITS)
+        got = C.unpack_g2_points(
+            C.g2_scalar_mul(C.pack_g2_points(pts), bits))
+        assert got == [pc.multiply(p, k) for p, k in zip(pts, ks)]
+
+    def test_generator_roundtrip(self):
+        got = C.unpack_g2_points(C.g2_generator(2))
+        assert got == [pc.G2_GEN, pc.G2_GEN]
+
+    def test_subgroup_order(self):
+        """r * G2 == infinity on device."""
+        bits = C.scalar_bits_from_ints([R], R.bit_length() + 1)
+        got = C.unpack_g2_points(C.g2_scalar_mul(C.g2_generator(1), bits))
+        assert got == [None]
