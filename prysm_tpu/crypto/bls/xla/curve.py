@@ -262,11 +262,11 @@ def _flatten(nested):
 # --- batched reductions ----------------------------------------------------
 
 
-def point_sum_tree(ops: FieldOps, pt, axis_size: int):
+def point_sum_tree(ops: FieldOps, pt):
     """Sum a batch of points along the leading batch axis by halving
     (log2 rounds of one batched add each)."""
     X, Y, Z = pt
-    n = axis_size
+    n = X.shape[0]
     while n > 1:
         half = (n + 1) // 2
         if n % 2 == 1:

@@ -260,21 +260,24 @@ def _bits_msb_first(e: int) -> np.ndarray:
     return np.array([int(c) for c in bin(e)[2:]], dtype=np.uint32)
 
 
-@partial(jax.jit, static_argnums=1)
-def fp_pow_fixed(a, e: int):
+def pow_fixed_generic(sqr, mul, a, e: int):
     """a**e for a static Python-int exponent, via lax.scan over the bit
-    string (left-to-right square-and-multiply, branchless select)."""
+    string (left-to-right square-and-multiply, scalar-predicate select).
+    Shared by the Fp/Fq2/Fq12 pow implementations."""
     bits = _bits_msb_first(e)
 
     def body(r, bit):
-        r = fp_sqr(r)
-        r = fp_select(jnp.broadcast_to(bit, r.shape[:-1]) == 1,
-                      fp_mul(r, a), r)
-        return r, None
+        r = sqr(r)
+        return jnp.where(bit == 1, mul(r, a), r), None
 
     # the leading bit is always 1: start from a and skip it
     r, _ = lax.scan(body, a, jnp.asarray(bits[1:]))
     return r
+
+
+@partial(jax.jit, static_argnums=1)
+def fp_pow_fixed(a, e: int):
+    return pow_fixed_generic(fp_sqr, fp_mul, a, e)
 
 
 @jax.jit

@@ -1,0 +1,230 @@
+"""Optimal ate pairing for BLS12-381 on JAX limbs (batched).
+
+Reference analog: blst's Miller loop + final exponentiation
+(crypto/bls L0, `CoreAggregateVerify` machinery [U, SURVEY.md §2]).
+
+TPU-first formulation:
+
+* The Miller loop runs as ONE ``lax.scan`` over the 63 post-leading
+  bits of |x| (static bit pattern, ``lax.cond`` on the scalar bit), so
+  the traced graph is a single double-step + add-step body regardless
+  of batch size.  All state (f in Fq12, T in Jacobian Fq2) carries
+  arbitrary leading batch dims — batching over signatures is free.
+* Line functions are evaluated projectively (no inversions).  With the
+  untwist psi(x,y) = (x/v, y/(v*w)) (w^2 = v, v^3 = xi), a line
+  l = c_y*yP - c_x*xP - c_0 lands in the sparse Fq12 basis
+  {1, w*v*xi^-1, w*v^2*xi^-1}; we scale every line by xi (an Fq2
+  constant killed by the final exponentiation) so the three slots are
+  (h=0,k=0) = xi*c_y*yP, (h=1,k=1) = c_0, (h=1,k=2) = c_x*xP.
+  Per-step Fq2* scalings (denominator elimination) are likewise killed
+  by the final exponentiation, so results match the pure golden model
+  bit-exactly after final exp.
+* ``multi_pairing``: batched Miller loops -> log-depth Fq12 product
+  tree -> ONE shared final exponentiation (the RLC batch-verify shape:
+  per-signature cost is a Miller loop only).
+
+Derivation of the Jacobian line coefficients (T = (X,Y,Z), x=X/Z^2,
+y=Y/Z^3; scale factors in Fq2* dropped freely):
+
+  doubling:  lambda = 3x^2/2y = E/Z3 (E = 3X^2, Z3 = 2YZ).  Scaling
+  the affine line by Z3*Z^2 gives  c_y = Z3*ZZ,  c_x = E*ZZ,
+  c_0 = 2B - E*X  with ZZ = Z^2, B = Y^2, and
+  l = c_y*yP - c_x*xP - c_0.
+
+  mixed addition of affine Q2=(x2,y2):  H = x2*ZZ - X, Rr = y2*Z*ZZ - Y,
+  Z3 = Z*H; scaling by Z3 gives  c_y = Z3,  c_x = Rr,
+  c_0 = Z3*y2 - Rr*x2.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..params import BLS_X_ABS, P, R
+from . import limbs as L
+from . import tower as T
+from .curve import FQ2_OPS, point_double
+
+# bits of |x| after the leading 1, MSB-first (static Python constants)
+X_BITS = [int(b) for b in bin(BLS_X_ABS)[3:]]
+
+# Hard part of the final exponentiation (matches pure.pairing.D_HARD so
+# results are bit-identical to the golden model).
+D_HARD = (P ** 4 - P ** 2 + 1) // R
+
+
+def _line_to_fq12(s00, s11, s12):
+    """Assemble a sparse line into a full Fq12 array (slots (0,0),
+    (1,1), (1,2) in the w/v/u nesting)."""
+    zero = jnp.zeros_like(s00)
+    c0 = jnp.stack([s00, zero, zero], axis=-3)
+    c1 = jnp.stack([zero, s11, s12], axis=-3)
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def _dbl_step(t, xp, yp):
+    """Double T and evaluate the tangent line at P=(xp, yp) (Fp).
+
+    Returns (T2, line_fq12)."""
+    X, Y, Z = t
+    A = T.fq2_sqr(X)                       # X^2
+    B = T.fq2_sqr(Y)                       # Y^2
+    ZZ = T.fq2_sqr(Z)
+    C = T.fq2_sqr(B)                       # Y^4
+    E = T.fq2_mul_small(A, 3)              # 3X^2
+    D = T.fq2_mul_small(
+        T.fq2_sub(T.fq2_sub(T.fq2_sqr(T.fq2_add(X, B)), A), C), 2)
+    F = T.fq2_sqr(E)
+    X3 = T.fq2_sub(F, T.fq2_mul_small(D, 2))
+    Y3 = T.fq2_sub(T.fq2_mul(E, T.fq2_sub(D, X3)), T.fq2_mul_small(C, 8))
+    Z3 = T.fq2_mul_small(T.fq2_mul(Y, Z), 2)
+
+    # line coefficients (see module docstring)
+    c_y = T.fq2_mul(Z3, ZZ)
+    c_x = T.fq2_mul(E, ZZ)
+    c_0 = T.fq2_sub(T.fq2_mul_small(B, 2), T.fq2_mul(E, X))
+    s00 = T.fq2_mul_by_xi(T.fq2_mul_fp(c_y, yp))
+    s12 = T.fq2_neg(T.fq2_mul_fp(c_x, xp))
+    s11 = T.fq2_neg(c_0)
+    return (X3, Y3, Z3), _line_to_fq12(s00, s11, s12)
+
+
+def _add_step(t, q_aff, xp, yp):
+    """Mixed-add affine Q into Jacobian T; line through T and Q at P."""
+    x2, y2 = q_aff
+    X, Y, Z = t
+    ZZ = T.fq2_sqr(Z)
+    U2 = T.fq2_mul(x2, ZZ)
+    S2 = T.fq2_mul(T.fq2_mul(y2, Z), ZZ)
+    H = T.fq2_sub(U2, X)
+    Rr = T.fq2_sub(S2, Y)
+    HH = T.fq2_sqr(H)
+    HHH = T.fq2_mul(H, HH)
+    V = T.fq2_mul(X, HH)
+    X3 = T.fq2_sub(T.fq2_sub(T.fq2_sqr(Rr), HHH), T.fq2_mul_small(V, 2))
+    Y3 = T.fq2_sub(T.fq2_mul(Rr, T.fq2_sub(V, X3)), T.fq2_mul(Y, HHH))
+    Z3 = T.fq2_mul(Z, H)
+
+    c_0 = T.fq2_sub(T.fq2_mul(Z3, y2), T.fq2_mul(Rr, x2))
+    s00 = T.fq2_mul_by_xi(T.fq2_mul_fp(Z3, yp))
+    s12 = T.fq2_neg(T.fq2_mul_fp(Rr, xp))
+    s11 = T.fq2_neg(c_0)
+    return (X3, Y3, Z3), _line_to_fq12(s00, s11, s12)
+
+
+@jax.jit
+def miller_loop(p_aff, q_aff):
+    """f_{|x|,Q}(P), conjugated for the negative x — batched.
+
+    p_aff: (xp, yp) Fp arrays (..., 24) — affine G1, NOT infinity.
+    q_aff: (x2, y2) Fq2 arrays (..., 2, 24) — affine G2, NOT infinity.
+    Callers mask infinities out separately (their pairing factor is 1).
+    """
+    xp, yp = p_aff
+    x2, y2 = q_aff
+    t0 = (x2, y2, T.fq2_one_like(x2))
+    f0 = T.fq12_one_like(
+        jnp.broadcast_to(x2[..., None, None, :, :],
+                         x2.shape[:-2] + (2, 3, 2, L.NLIMBS)))
+
+    bits = jnp.asarray(np.array(X_BITS, dtype=np.uint32))
+
+    def body(carry, bit):
+        f, t = carry
+        f = T.fq12_sqr(f)
+        t, line = _dbl_step(t, xp, yp)
+        f = T.fq12_mul(f, line)
+
+        def with_add(args):
+            f, t = args
+            t2, line2 = _add_step(t, (x2, y2), xp, yp)
+            return T.fq12_mul(f, line2), t2
+
+        f, t = lax.cond(bit == 1, with_add, lambda a: a, (f, t))
+        return (f, t), None
+
+    (f, _), _ = lax.scan(body, (f0, t0), bits)
+    # x < 0: conjugate
+    return T.fq12_conj(f)
+
+
+@jax.jit
+def fq12_prod_tree(f):
+    """Product over the leading batch axis by halving (log2 rounds)."""
+    n = f.shape[0]
+    while n > 1:
+        half = (n + 1) // 2
+        if n % 2 == 1:
+            pad = T.fq12_one_like(f[:1])
+            f = jnp.concatenate([f, pad], axis=0)
+        f = T.fq12_mul(f[:half], f[half:2 * half])
+        n = half
+    return f[0]
+
+
+@jax.jit
+def final_exponentiation(f):
+    """f^((p^12-1)/r): easy part via Frobenius + inversion, hard part
+    as a generic fixed-exponent scan pow (matches pure bit-exactly).
+    One shared call per batch — cost amortizes in multi_pairing."""
+    f1 = T.fq12_mul(T.fq12_conj(f), T.fq12_inv(f))     # f^(p^6-1)
+    f2 = T.fq12_mul(T.fq12_frobenius(f1, 2), f1)       # ^(p^2+1)
+    return T.fq12_pow_fixed(f2, D_HARD)
+
+
+def multi_pairing_device(p_aff, q_aff, mask):
+    """prod_i e(P_i, Q_i)^mask_i with one shared final exponentiation.
+
+    mask: bool (n,) — False entries contribute 1 (infinity inputs)."""
+    f = miller_loop(p_aff, q_aff)
+    f = T.fq12_select(mask, f, T.fq12_one_like(f))
+    return final_exponentiation(fq12_prod_tree(f))
+
+
+@jax.jit
+def is_fq12_one(f):
+    """f == 1 elementwise over trailing Fq12 dims (Montgomery form)."""
+    one = T.fq12_one_like(f)
+    return jnp.all(f == one, axis=(-1, -2, -3, -4))
+
+
+# --- host-facing helpers (pack pure points, run device pairing) ------------
+
+
+def pairing(p_g1, q_g2) -> "object":
+    """e(P, Q) for single pure affine points -> pure Fq12 (host)."""
+    from .curve import pack_g1_points, pack_g2_points
+    from . import tower
+
+    if p_g1 is None or q_g2 is None:
+        from ..pure.fields import Fq12 as PureFq12
+
+        return PureFq12.one()
+    x1, y1, _ = pack_g1_points([p_g1])
+    x2, y2, _ = pack_g2_points([q_g2])
+    mask = jnp.ones((1,), dtype=bool)
+    out = multi_pairing_device((x1, y1), (x2, y2), mask)
+    return tower.unpack_fq12(out[None])[0]
+
+
+def multi_pairing(pairs) -> "object":
+    """prod e(P_i, Q_i) for pure affine point pairs -> pure Fq12."""
+    from .curve import pack_g1_points, pack_g2_points
+    from . import tower
+
+    live = [(p, q) for p, q in pairs if p is not None and q is not None]
+    if not live:
+        from ..pure.fields import Fq12 as PureFq12
+
+        return PureFq12.one()
+    x1, y1, _ = pack_g1_points([p for p, _ in live])
+    x2, y2, _ = pack_g2_points([q for _, q in live])
+    mask = jnp.ones((len(live),), dtype=bool)
+    out = multi_pairing_device((x1, y1), (x2, y2), mask)
+    return tower.unpack_fq12(out[None])[0]

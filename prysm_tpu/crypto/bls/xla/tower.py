@@ -180,16 +180,7 @@ def fq2_one_like(a):
 
 @partial(jax.jit, static_argnums=1)
 def fq2_pow_fixed(a, e: int):
-    bits = L._bits_msb_first(e)
-
-    def body(r, bit):
-        r = fq2_sqr(r)
-        r = fq2_select(jnp.broadcast_to(bit, r.shape[:-2]) == 1,
-                       fq2_mul(r, a), r)
-        return r, None
-
-    r, _ = lax.scan(body, a, jnp.asarray(bits[1:]))
-    return r
+    return L.pow_fixed_generic(fq2_sqr, fq2_mul, a, e)
 
 
 # --- Fq6 -------------------------------------------------------------------
@@ -347,16 +338,7 @@ def fq12_zero_like(a):
 @partial(jax.jit, static_argnums=1)
 def fq12_pow_fixed(a, e: int):
     """a**e for static e via lax.scan (generic square-and-multiply)."""
-    bits = L._bits_msb_first(e)
-
-    def body(r, bit):
-        r = fq12_sqr(r)
-        r = fq12_select(jnp.broadcast_to(bit, r.shape[:-4]) == 1,
-                        fq12_mul(r, a), r)
-        return r, None
-
-    r, _ = lax.scan(body, a, jnp.asarray(bits[1:]))
-    return r
+    return L.pow_fixed_generic(fq12_sqr, fq12_mul, a, e)
 
 
 # --- Frobenius -------------------------------------------------------------

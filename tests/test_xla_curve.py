@@ -61,7 +61,7 @@ class TestG1:
     def test_sum_tree(self, rng):
         pts = rand_g1(rng, 5)
         dev = C.pack_g1_points(pts)
-        total = C.point_sum_tree(C.FP_OPS, dev, 5)
+        total = C.point_sum_tree(C.FP_OPS, dev)
         got = C.unpack_g1_points(tuple(t[None] for t in total))
         want = None
         for p in pts:

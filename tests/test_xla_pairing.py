@@ -1,0 +1,65 @@
+"""Differential tests: XLA pairing vs the pure golden model.
+
+The Miller-loop normalizations differ between the two implementations
+(projective denominator elimination vs affine lines), so comparisons
+happen after the final exponentiation, where the pairing value is
+canonical."""
+
+import random
+
+import pytest
+
+from prysm_tpu.crypto.bls.params import R
+from prysm_tpu.crypto.bls.pure import curve as pc
+from prysm_tpu.crypto.bls.pure import pairing as pp
+from prysm_tpu.crypto.bls.pure.fields import Fq12
+from prysm_tpu.crypto.bls.xla import pairing as xp
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(0x9A1F1)
+
+
+class TestPairing:
+    def test_matches_pure(self, rng):
+        """e(P, Q) on device == pure e(P, Q), random points."""
+        for _ in range(2):
+            a = rng.randrange(1, R)
+            b = rng.randrange(1, R)
+            p = pc.multiply(pc.G1_GEN, a)
+            q = pc.multiply(pc.G2_GEN, b)
+            assert xp.pairing(p, q) == pp.pairing(p, q)
+
+    def test_generator_pairing(self):
+        assert (xp.pairing(pc.G1_GEN, pc.G2_GEN)
+                == pp.pairing(pc.G1_GEN, pc.G2_GEN))
+
+    def test_bilinearity_on_device(self, rng):
+        """e([a]P, Q) == e(P, [b]Q) when a == b (device only)."""
+        a = rng.randrange(1, R)
+        pa = pc.multiply(pc.G1_GEN, a)
+        qa = pc.multiply(pc.G2_GEN, a)
+        assert xp.pairing(pa, pc.G2_GEN) == xp.pairing(pc.G1_GEN, qa)
+
+    def test_multi_pairing_cancellation(self, rng):
+        """e(-P, Q) * e(P, Q) == 1 — the verify-equation shape."""
+        a = rng.randrange(1, R)
+        p = pc.multiply(pc.G1_GEN, a)
+        q = pc.multiply(pc.G2_GEN, rng.randrange(1, R))
+        out = xp.multi_pairing([(pc.neg(p), q), (p, q)])
+        assert out == Fq12.one()
+
+    def test_multi_pairing_matches_pure(self, rng):
+        pairs = []
+        for _ in range(3):
+            pairs.append((pc.multiply(pc.G1_GEN, rng.randrange(1, R)),
+                          pc.multiply(pc.G2_GEN, rng.randrange(1, R))))
+        assert xp.multi_pairing(pairs) == pp.multi_pairing(pairs)
+
+    def test_multi_pairing_with_infinity(self, rng):
+        """Infinity entries contribute the identity factor."""
+        p = pc.multiply(pc.G1_GEN, rng.randrange(1, R))
+        q = pc.multiply(pc.G2_GEN, rng.randrange(1, R))
+        assert (xp.multi_pairing([(p, q), (None, q), (p, None)])
+                == pp.pairing(p, q))
