@@ -1,0 +1,5 @@
+"""Consensus core: pure deterministic state-transition functions.
+
+Reference analog: ``beacon-chain/core/{helpers,signing,transition,
+blocks,epoch}`` [U, SURVEY.md §2 L4] — the side-effect-free tier that
+maps cleanly onto accelerator-friendly batch computation."""

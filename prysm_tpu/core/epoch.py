@@ -1,0 +1,307 @@
+"""Epoch processing: justification/finalization, rewards, registry.
+
+Reference analog: ``beacon-chain/core/epoch`` (+ ``precompute/``) [U,
+SURVEY.md §2].  The per-validator flag precompute pattern is mirrored:
+one pass computes source/target/head participation per validator, then
+deltas are assembled from the flags.
+"""
+
+from __future__ import annotations
+
+from ..config import beacon_config
+from .helpers import (
+    BASE_REWARDS_PER_EPOCH, FAR_FUTURE_EPOCH, GENESIS_EPOCH,
+    compute_activation_exit_epoch, decrease_balance,
+    get_active_validator_indices, get_attesting_indices,
+    get_block_root, get_block_root_at_slot, get_current_epoch,
+    get_previous_epoch, get_randao_mix, get_total_active_balance,
+    get_total_balance, get_validator_churn_limit, increase_balance,
+    integer_squareroot, is_active_validator, is_eligible_for_activation,
+    is_eligible_for_activation_queue,
+)
+
+# hysteresis uses these derived quotients (spec phase-0)
+
+
+def get_matching_source_attestations(state, epoch: int):
+    if epoch == get_current_epoch(state):
+        return list(state.current_epoch_attestations)
+    if epoch == get_previous_epoch(state):
+        return list(state.previous_epoch_attestations)
+    raise ValueError("epoch not current or previous")
+
+
+def get_matching_target_attestations(state, epoch: int):
+    target_root = get_block_root(state, epoch)
+    return [a for a in get_matching_source_attestations(state, epoch)
+            if a.data.target.root == target_root]
+
+
+def get_matching_head_attestations(state, epoch: int):
+    return [a for a in get_matching_target_attestations(state, epoch)
+            if a.data.beacon_block_root
+            == get_block_root_at_slot(state, a.data.slot)]
+
+
+def get_unslashed_attesting_indices(state, attestations) -> set[int]:
+    out: set[int] = set()
+    for a in attestations:
+        out |= get_attesting_indices(state, a.data, a.aggregation_bits)
+    return {i for i in out if not state.validators[i].slashed}
+
+
+def get_attesting_balance(state, attestations) -> int:
+    return get_total_balance(
+        state, get_unslashed_attesting_indices(state, attestations))
+
+
+# --- justification & finalization ------------------------------------------
+
+
+def process_justification_and_finalization(state) -> None:
+    from ..proto import Checkpoint
+
+    if get_current_epoch(state) <= GENESIS_EPOCH + 1:
+        return
+    previous_epoch = get_previous_epoch(state)
+    current_epoch = get_current_epoch(state)
+    old_previous_justified = state.previous_justified_checkpoint
+    old_current_justified = state.current_justified_checkpoint
+
+    # process justification
+    state.previous_justified_checkpoint = (
+        state.current_justified_checkpoint)
+    bits = list(state.justification_bits)
+    bits = [False] + bits[:-1]
+    total = get_total_active_balance(state)
+    if (get_attesting_balance(
+            state, get_matching_target_attestations(state, previous_epoch))
+            * 3 >= total * 2):
+        state.current_justified_checkpoint = Checkpoint(
+            epoch=previous_epoch,
+            root=get_block_root(state, previous_epoch))
+        bits[1] = True
+    if (get_attesting_balance(
+            state, get_matching_target_attestations(state, current_epoch))
+            * 3 >= total * 2):
+        state.current_justified_checkpoint = Checkpoint(
+            epoch=current_epoch,
+            root=get_block_root(state, current_epoch))
+        bits[0] = True
+    state.justification_bits = bits
+
+    # process finalization
+    # 2nd/3rd/4th most recent epochs justified -> finalize
+    if (all(bits[1:4]) and old_previous_justified.epoch + 3
+            == current_epoch):
+        state.finalized_checkpoint = old_previous_justified
+    if (all(bits[1:3]) and old_previous_justified.epoch + 2
+            == current_epoch):
+        state.finalized_checkpoint = old_previous_justified
+    if (all(bits[0:3]) and old_current_justified.epoch + 2
+            == current_epoch):
+        state.finalized_checkpoint = old_current_justified
+    if (all(bits[0:2]) and old_current_justified.epoch + 1
+            == current_epoch):
+        state.finalized_checkpoint = old_current_justified
+
+
+# --- rewards & penalties ---------------------------------------------------
+
+
+def get_base_reward(state, index: int, total_balance: int | None = None
+                    ) -> int:
+    cfg = beacon_config()
+    if total_balance is None:
+        total_balance = get_total_active_balance(state)
+    eff = state.validators[index].effective_balance
+    return (eff * cfg.base_reward_factor
+            // integer_squareroot(total_balance)
+            // BASE_REWARDS_PER_EPOCH)
+
+
+def get_finality_delay(state) -> int:
+    return get_previous_epoch(state) - state.finalized_checkpoint.epoch
+
+
+def is_in_inactivity_leak(state) -> bool:
+    cfg = beacon_config()
+    return get_finality_delay(state) > cfg.min_epochs_to_inactivity_penalty
+
+
+def get_eligible_validator_indices(state) -> list[int]:
+    previous_epoch = get_previous_epoch(state)
+    return [i for i, v in enumerate(state.validators)
+            if is_active_validator(v, previous_epoch)
+            or (v.slashed
+                and previous_epoch + 1 < v.withdrawable_epoch)]
+
+
+def get_proposer_reward(state, attester_index: int, total: int) -> int:
+    cfg = beacon_config()
+    return (get_base_reward(state, attester_index, total)
+            // cfg.proposer_reward_quotient)
+
+
+def get_attestation_deltas(state) -> tuple[list[int], list[int]]:
+    cfg = beacon_config()
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    previous_epoch = get_previous_epoch(state)
+    total_balance = get_total_active_balance(state)
+    eligible = get_eligible_validator_indices(state)
+    increment = cfg.effective_balance_increment
+
+    matching_source = get_matching_source_attestations(state,
+                                                       previous_epoch)
+    matching_target = get_matching_target_attestations(state,
+                                                       previous_epoch)
+    matching_head = get_matching_head_attestations(state, previous_epoch)
+
+    for attestations, _name in ((matching_source, "source"),
+                                (matching_target, "target"),
+                                (matching_head, "head")):
+        unslashed = get_unslashed_attesting_indices(state, attestations)
+        attesting_balance = get_total_balance(state, unslashed)
+        for index in eligible:
+            base = get_base_reward(state, index, total_balance)
+            if index in unslashed:
+                if is_in_inactivity_leak(state):
+                    rewards[index] += base
+                else:
+                    reward_num = base * (attesting_balance // increment)
+                    rewards[index] += (reward_num
+                                       // (total_balance // increment))
+            else:
+                penalties[index] += base
+
+    # inclusion delay: proposer + attester micro-rewards
+    source_unslashed = get_unslashed_attesting_indices(state,
+                                                       matching_source)
+    for index in source_unslashed:
+        candidates = [a for a in matching_source
+                      if index in get_attesting_indices(
+                          state, a.data, a.aggregation_bits)]
+        attestation = min(candidates, key=lambda a: a.inclusion_delay)
+        base = get_base_reward(state, index, total_balance)
+        proposer_reward = base // cfg.proposer_reward_quotient
+        rewards[attestation.proposer_index] += proposer_reward
+        max_attester_reward = base - proposer_reward
+        rewards[index] += (max_attester_reward
+                           // attestation.inclusion_delay)
+
+    # inactivity leak
+    if is_in_inactivity_leak(state):
+        target_unslashed = get_unslashed_attesting_indices(
+            state, matching_target)
+        for index in eligible:
+            base = get_base_reward(state, index, total_balance)
+            penalties[index] += (BASE_REWARDS_PER_EPOCH * base
+                                 - base // cfg.proposer_reward_quotient)
+            if index not in target_unslashed:
+                eff = state.validators[index].effective_balance
+                penalties[index] += (
+                    eff * get_finality_delay(state)
+                    // cfg.inactivity_penalty_quotient)
+
+    return rewards, penalties
+
+
+def process_rewards_and_penalties(state) -> None:
+    if get_current_epoch(state) == GENESIS_EPOCH:
+        return
+    rewards, penalties = get_attestation_deltas(state)
+    for index in range(len(state.validators)):
+        increase_balance(state, index, rewards[index])
+        decrease_balance(state, index, penalties[index])
+
+
+# --- registry updates ------------------------------------------------------
+
+
+def process_registry_updates(state) -> None:
+    cfg = beacon_config()
+    ejection = cfg.ejection_balance
+    from .validators import initiate_validator_exit
+
+    current_epoch = get_current_epoch(state)
+    for index, v in enumerate(state.validators):
+        if is_eligible_for_activation_queue(v, cfg):
+            v.activation_eligibility_epoch = current_epoch + 1
+        if (is_active_validator(v, current_epoch)
+                and v.effective_balance <= ejection):
+            initiate_validator_exit(state, index, cfg)
+
+    activation_queue = sorted(
+        (i for i, v in enumerate(state.validators)
+         if is_eligible_for_activation(state, v)),
+        key=lambda i: (state.validators[i].activation_eligibility_epoch,
+                       i))
+    for index in activation_queue[:get_validator_churn_limit(state, cfg)]:
+        state.validators[index].activation_epoch = (
+            compute_activation_exit_epoch(current_epoch, cfg))
+
+
+def process_slashings(state) -> None:
+    cfg = beacon_config()
+    epoch = get_current_epoch(state)
+    total_balance = get_total_active_balance(state)
+    adjusted_total = min(
+        sum(state.slashings) * cfg.proportional_slashing_multiplier,
+        total_balance)
+    for index, v in enumerate(state.validators):
+        if (v.slashed and epoch + cfg.epochs_per_slashings_vector // 2
+                == v.withdrawable_epoch):
+            increment = cfg.effective_balance_increment
+            penalty_numerator = (v.effective_balance // increment
+                                 * adjusted_total)
+            penalty = penalty_numerator // total_balance * increment
+            decrease_balance(state, index, penalty)
+
+
+def process_final_updates(state) -> None:
+    cfg = beacon_config()
+    current_epoch = get_current_epoch(state)
+    next_epoch = current_epoch + 1
+    # eth1 data votes reset
+    if (state.slot + 1) % cfg.slots_per_eth1_voting_period() == 0:
+        state.eth1_data_votes = []
+    # effective balance updates (hysteresis)
+    increment = cfg.effective_balance_increment
+    hysteresis_increment = increment // cfg.hysteresis_quotient
+    downward = hysteresis_increment * cfg.hysteresis_downward_multiplier
+    upward = hysteresis_increment * cfg.hysteresis_upward_multiplier
+    for index, v in enumerate(state.validators):
+        balance = state.balances[index]
+        if (balance + downward < v.effective_balance
+                or v.effective_balance + upward < balance):
+            v.effective_balance = min(balance - balance % increment,
+                                      cfg.max_effective_balance)
+    # slashings reset
+    state.slashings[next_epoch % cfg.epochs_per_slashings_vector] = 0
+    # randao mix carry-forward
+    state.randao_mixes[next_epoch % cfg.epochs_per_historical_vector] = (
+        get_randao_mix(state, current_epoch, cfg))
+    # historical roots
+    if next_epoch % (cfg.slots_per_historical_root
+                     // cfg.slots_per_epoch) == 0:
+        from ..proto import active_types
+
+        types = active_types()
+        batch = types.HistoricalBatch(
+            block_roots=list(state.block_roots),
+            state_roots=list(state.state_roots))
+        state.historical_roots.append(
+            types.HistoricalBatch.hash_tree_root(batch))
+    # rotate epoch attestations
+    state.previous_epoch_attestations = state.current_epoch_attestations
+    state.current_epoch_attestations = []
+
+
+def process_epoch(state) -> None:
+    process_justification_and_finalization(state)
+    process_rewards_and_penalties(state)
+    process_registry_updates(state)
+    process_slashings(state)
+    process_final_updates(state)

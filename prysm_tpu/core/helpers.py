@@ -1,0 +1,367 @@
+"""Consensus helpers: shuffling, committees, randomness, balances.
+
+Reference analog: ``beacon-chain/core/helpers`` (BeaconCommitteeFromState,
+ComputeShuffledIndex, Domain, committee cache) [U, SURVEY.md §2].
+"""
+
+from __future__ import annotations
+
+import hashlib
+from functools import lru_cache
+
+from ..config import BeaconChainConfig, beacon_config
+from ..proto import (
+    AttestationData, ForkData, IndexedAttestation, SigningData,
+)
+
+FAR_FUTURE_EPOCH = 2 ** 64 - 1
+BASE_REWARDS_PER_EPOCH = 4
+GENESIS_EPOCH = 0
+GENESIS_SLOT = 0
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def integer_squareroot(n: int) -> int:
+    if n < 0:
+        raise ValueError("negative")
+    x, y = n, (n + 1) // 2
+    while y < x:
+        x, y = y, (y + n // y) // 2
+    return x
+
+
+# --- time ------------------------------------------------------------------
+
+
+def compute_epoch_at_slot(slot: int, cfg: BeaconChainConfig | None = None
+                          ) -> int:
+    cfg = cfg or beacon_config()
+    return slot // cfg.slots_per_epoch
+
+
+def compute_start_slot_at_epoch(epoch: int,
+                                cfg: BeaconChainConfig | None = None) -> int:
+    cfg = cfg or beacon_config()
+    return epoch * cfg.slots_per_epoch
+
+
+def compute_activation_exit_epoch(epoch: int,
+                                  cfg: BeaconChainConfig | None = None
+                                  ) -> int:
+    cfg = cfg or beacon_config()
+    return epoch + 1 + cfg.max_seed_lookahead
+
+
+def get_current_epoch(state) -> int:
+    return compute_epoch_at_slot(state.slot)
+
+
+def get_previous_epoch(state) -> int:
+    cur = get_current_epoch(state)
+    return cur - 1 if cur > GENESIS_EPOCH else GENESIS_EPOCH
+
+
+# --- validators ------------------------------------------------------------
+
+
+def is_active_validator(v, epoch: int) -> bool:
+    return v.activation_epoch <= epoch < v.exit_epoch
+
+
+def is_eligible_for_activation_queue(v, cfg=None) -> bool:
+    cfg = cfg or beacon_config()
+    return (v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance == cfg.max_effective_balance)
+
+
+def is_eligible_for_activation(state, v) -> bool:
+    return (v.activation_eligibility_epoch
+            <= state.finalized_checkpoint.epoch
+            and v.activation_epoch == FAR_FUTURE_EPOCH)
+
+
+def is_slashable_validator(v, epoch: int) -> bool:
+    return (not v.slashed
+            and v.activation_epoch <= epoch < v.withdrawable_epoch)
+
+
+def get_active_validator_indices(state, epoch: int) -> list[int]:
+    return [i for i, v in enumerate(state.validators)
+            if is_active_validator(v, epoch)]
+
+
+def get_validator_churn_limit(state, cfg=None) -> int:
+    cfg = cfg or beacon_config()
+    active = len(get_active_validator_indices(state,
+                                              get_current_epoch(state)))
+    return max(cfg.min_per_epoch_churn_limit,
+               active // cfg.churn_limit_quotient)
+
+
+# --- balances --------------------------------------------------------------
+
+
+def get_total_balance(state, indices, cfg=None) -> int:
+    cfg = cfg or beacon_config()
+    return max(cfg.effective_balance_increment,
+               sum(state.validators[i].effective_balance for i in indices))
+
+
+def get_total_active_balance(state) -> int:
+    return get_total_balance(
+        state, get_active_validator_indices(state, get_current_epoch(state)))
+
+
+def increase_balance(state, index: int, delta: int) -> None:
+    state.balances[index] += delta
+
+
+def decrease_balance(state, index: int, delta: int) -> None:
+    state.balances[index] = max(0, state.balances[index] - delta)
+
+
+# --- randomness / roots ----------------------------------------------------
+
+
+def get_randao_mix(state, epoch: int, cfg=None) -> bytes:
+    cfg = cfg or beacon_config()
+    return state.randao_mixes[epoch % cfg.epochs_per_historical_vector]
+
+
+def get_seed(state, epoch: int, domain_type: bytes, cfg=None) -> bytes:
+    cfg = cfg or beacon_config()
+    mix = get_randao_mix(
+        state, epoch + cfg.epochs_per_historical_vector
+        - cfg.min_seed_lookahead - 1, cfg)
+    return _sha256(domain_type + epoch.to_bytes(8, "little") + mix)
+
+
+def get_block_root_at_slot(state, slot: int, cfg=None) -> bytes:
+    cfg = cfg or beacon_config()
+    if not (slot < state.slot <= slot + cfg.slots_per_historical_root):
+        raise ValueError("slot out of block-root range")
+    return state.block_roots[slot % cfg.slots_per_historical_root]
+
+
+def get_block_root(state, epoch: int, cfg=None) -> bytes:
+    return get_block_root_at_slot(
+        state, compute_start_slot_at_epoch(epoch, cfg), cfg)
+
+
+# --- shuffling (swap-or-not) -----------------------------------------------
+
+
+def compute_shuffled_index(index: int, count: int, seed: bytes,
+                           cfg=None) -> int:
+    """Spec swap-or-not shuffle for a single index."""
+    cfg = cfg or beacon_config()
+    if index >= count:
+        raise ValueError("index out of range")
+    for r in range(cfg.shuffle_round_count):
+        pivot = int.from_bytes(
+            _sha256(seed + bytes([r]))[:8], "little") % count
+        flip = (pivot + count - index) % count
+        position = max(index, flip)
+        source = _sha256(seed + bytes([r])
+                         + (position // 256).to_bytes(4, "little"))
+        byte = source[(position % 256) // 8]
+        if (byte >> (position % 8)) & 1:
+            index = flip
+    return index
+
+
+@lru_cache(maxsize=64)
+def _shuffled_map_cached(seed: bytes, count: int, rounds: int
+                         ) -> tuple[int, ...]:
+    """Full-list swap-or-not pass (the reference's UnshuffleList-style
+    optimization): out[pos] == compute_shuffled_index(pos, count, seed)
+    for every pos, at O(rounds * n / 256) hashes for the whole list.
+
+    Each round's swap is an involution, so applying the rounds to the
+    identity list in REVERSED order materializes the forward per-index
+    map (verified against compute_shuffled_index in tests)."""
+    items = list(range(count))
+    if count <= 1:
+        return tuple(items)
+    for r in reversed(range(rounds)):
+        pivot = int.from_bytes(
+            _sha256(seed + bytes([r]))[:8], "little") % count
+        sources: dict[int, bytes] = {}
+
+        def bit_at(position: int) -> int:
+            chunk = position // 256
+            if chunk not in sources:
+                sources[chunk] = _sha256(
+                    seed + bytes([r]) + chunk.to_bytes(4, "little"))
+            byte = sources[chunk][(position % 256) // 8]
+            return (byte >> (position % 8)) & 1
+
+        for i in range(count):
+            flip = (pivot + count - i) % count
+            if i < flip and bit_at(max(i, flip)):
+                items[i], items[flip] = items[flip], items[i]
+    return tuple(items)
+
+
+def shuffled_index_map(seed: bytes, count: int, cfg=None
+                       ) -> tuple[int, ...]:
+    """out[pos] = compute_shuffled_index(pos, count, seed) (cached)."""
+    cfg = cfg or beacon_config()
+    return _shuffled_map_cached(seed, count, cfg.shuffle_round_count)
+
+
+def compute_committee(indices: list[int], seed: bytes, index: int,
+                      count: int, cfg=None) -> list[int]:
+    """Committee `index` of `count` over shuffled `indices`."""
+    cfg = cfg or beacon_config()
+    n = len(indices)
+    start = n * index // count
+    end = n * (index + 1) // count
+    smap = shuffled_index_map(seed, n, cfg)
+    return [indices[smap[i]] for i in range(start, end)]
+
+
+def get_committee_count_per_slot(state, epoch: int, cfg=None) -> int:
+    cfg = cfg or beacon_config()
+    active = len(get_active_validator_indices(state, epoch))
+    return max(1, min(
+        cfg.max_committees_per_slot,
+        active // cfg.slots_per_epoch // cfg.target_committee_size))
+
+
+def get_beacon_committee(state, slot: int, index: int, cfg=None
+                         ) -> list[int]:
+    cfg = cfg or beacon_config()
+    epoch = compute_epoch_at_slot(slot, cfg)
+    committees_per_slot = get_committee_count_per_slot(state, epoch, cfg)
+    indices = get_active_validator_indices(state, epoch)
+    seed = get_seed(state, epoch, cfg.domain_beacon_attester, cfg)
+    return compute_committee(
+        indices, seed,
+        (slot % cfg.slots_per_epoch) * committees_per_slot + index,
+        committees_per_slot * cfg.slots_per_epoch, cfg)
+
+
+def compute_proposer_index(state, indices: list[int], seed: bytes,
+                           cfg=None) -> int:
+    """Effective-balance-weighted rejection sampling."""
+    cfg = cfg or beacon_config()
+    if not indices:
+        raise ValueError("empty validator set")
+    max_random_byte = 255
+    i = 0
+    total = len(indices)
+    while True:
+        candidate = indices[compute_shuffled_index(i % total, total, seed,
+                                                   cfg)]
+        random_byte = _sha256(
+            seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        eff = state.validators[candidate].effective_balance
+        if (eff * max_random_byte
+                >= cfg.max_effective_balance * random_byte):
+            return candidate
+        i += 1
+
+
+def get_beacon_proposer_index(state, cfg=None) -> int:
+    cfg = cfg or beacon_config()
+    epoch = get_current_epoch(state)
+    seed = _sha256(
+        get_seed(state, epoch, cfg.domain_beacon_proposer, cfg)
+        + state.slot.to_bytes(8, "little"))
+    indices = get_active_validator_indices(state, epoch)
+    return compute_proposer_index(state, indices, seed, cfg)
+
+
+# --- domains / signing -----------------------------------------------------
+
+
+def compute_fork_data_root(current_version: bytes,
+                           genesis_validators_root: bytes) -> bytes:
+    return ForkData(
+        current_version=current_version,
+        genesis_validators_root=genesis_validators_root).root()
+
+
+def compute_fork_digest(current_version: bytes,
+                        genesis_validators_root: bytes) -> bytes:
+    return compute_fork_data_root(
+        current_version, genesis_validators_root)[:4]
+
+
+def compute_domain(domain_type: bytes, fork_version: bytes | None = None,
+                   genesis_validators_root: bytes | None = None,
+                   cfg=None) -> bytes:
+    cfg = cfg or beacon_config()
+    if fork_version is None:
+        fork_version = cfg.genesis_fork_version
+    if genesis_validators_root is None:
+        genesis_validators_root = b"\x00" * 32
+    fork_data_root = compute_fork_data_root(fork_version,
+                                            genesis_validators_root)
+    return domain_type + fork_data_root[:28]
+
+
+def get_domain(state, domain_type: bytes, epoch: int | None = None,
+               cfg=None) -> bytes:
+    cfg = cfg or beacon_config()
+    epoch = get_current_epoch(state) if epoch is None else epoch
+    fork_version = (state.fork.previous_version
+                    if epoch < state.fork.epoch
+                    else state.fork.current_version)
+    return compute_domain(domain_type, fork_version,
+                          state.genesis_validators_root, cfg)
+
+
+def compute_signing_root(obj, domain: bytes) -> bytes:
+    return SigningData(object_root=obj.root(), domain=domain).root()
+
+
+# --- attestations ----------------------------------------------------------
+
+
+def get_attesting_indices(state, data: AttestationData, bits,
+                          cfg=None) -> set[int]:
+    committee = get_beacon_committee(state, data.slot, data.index, cfg)
+    if len(bits) != len(committee):
+        raise ValueError("aggregation bits length != committee size")
+    return {idx for i, idx in enumerate(committee) if bits[i]}
+
+
+def get_indexed_attestation(state, attestation, cfg=None
+                            ) -> IndexedAttestation:
+    indices = get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits, cfg)
+    return IndexedAttestation(
+        attesting_indices=sorted(indices),
+        data=attestation.data,
+        signature=attestation.signature)
+
+
+def is_slashable_attestation_data(d1: AttestationData,
+                                  d2: AttestationData) -> bool:
+    return ((d1 != d2 and d1.target.epoch == d2.target.epoch)
+            or (d1.source.epoch < d2.source.epoch
+                and d2.target.epoch < d1.target.epoch))
+
+
+def is_valid_indexed_attestation(state, indexed, cfg=None) -> bool:
+    """Sorted-unique indices + aggregate BLS check (crypto hot path)."""
+    cfg = cfg or beacon_config()
+    indices = list(indexed.attesting_indices)
+    if not indices or indices != sorted(set(indices)):
+        return False
+    if any(i >= len(state.validators) for i in indices):
+        return False
+    from ..crypto.bls import bls
+
+    pks = [bls.PublicKey.from_bytes(state.validators[i].pubkey)
+           for i in indices]
+    domain = get_domain(state, cfg.domain_beacon_attester,
+                        indexed.data.target.epoch, cfg)
+    root = compute_signing_root(indexed.data, domain)
+    sig = bls.Signature.from_bytes(indexed.signature)
+    return sig.fast_aggregate_verify(pks, root)

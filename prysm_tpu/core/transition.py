@@ -1,0 +1,392 @@
+"""State transition: process_slots + process_block + operations.
+
+Reference analog: ``beacon-chain/core/transition`` (ExecuteStateTransition,
+ProcessSlots) and ``core/blocks`` (ProcessBlockHeader/Randao/Attestations/
+Deposits/Exits, VerifyAttestationSignatures / AttestationSignatureBatch)
+[U, SURVEY.md §2, §3.2].
+
+Signature handling mirrors the reference's batch design: the
+default path verifies per-operation; ``collect_block_signature_batch``
+returns the block's signature work as one ``SignatureBatch`` so callers
+(blockchain service / initial-sync) can defer to a single TPU dispatch
+per block or per batch of blocks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..config import beacon_config
+from ..crypto.bls import bls
+from ..proto import (
+    Attestation, BeaconBlockHeader, DepositData, DepositMessage,
+    PendingAttestation,
+)
+from ..ssz import hash_tree_root
+from . import epoch as epoch_processing
+from .helpers import (
+    FAR_FUTURE_EPOCH, compute_domain, compute_epoch_at_slot,
+    compute_signing_root, get_attesting_indices, get_beacon_committee,
+    get_beacon_proposer_index, get_committee_count_per_slot,
+    get_current_epoch, get_domain, get_indexed_attestation,
+    get_previous_epoch, get_randao_mix, increase_balance,
+    is_slashable_attestation_data, is_slashable_validator,
+    is_valid_indexed_attestation,
+)
+from .validators import initiate_validator_exit, slash_validator
+
+
+class StateTransitionError(Exception):
+    """Invalid block / operation (reference returns err from
+    ExecuteStateTransition)."""
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise StateTransitionError(msg)
+
+
+# --- slot processing -------------------------------------------------------
+
+
+def process_slot(state, types) -> None:
+    cfg = beacon_config()
+    previous_state_root = types.BeaconState.hash_tree_root(state)
+    state.state_roots[state.slot % cfg.slots_per_historical_root] = (
+        previous_state_root)
+    if state.latest_block_header.state_root == b"\x00" * 32:
+        state.latest_block_header.state_root = previous_state_root
+    previous_block_root = state.latest_block_header.root()
+    state.block_roots[state.slot % cfg.slots_per_historical_root] = (
+        previous_block_root)
+
+
+def process_slots(state, slot: int, types=None) -> None:
+    from ..proto import active_types
+
+    types = types or active_types()
+    cfg = beacon_config()
+    _require(state.slot <= slot, "cannot process past slot backwards")
+    while state.slot < slot:
+        process_slot(state, types)
+        if (state.slot + 1) % cfg.slots_per_epoch == 0:
+            epoch_processing.process_epoch(state)
+        state.slot += 1
+
+
+# --- block processing ------------------------------------------------------
+
+
+def verify_block_signature(state, signed_block) -> bool:
+    proposer = state.validators[signed_block.message.proposer_index]
+    domain = get_domain(state, beacon_config().domain_beacon_proposer)
+    root = compute_signing_root(signed_block.message, domain)
+    return bls.Signature.from_bytes(signed_block.signature).verify(
+        bls.PublicKey.from_bytes(proposer.pubkey), root)
+
+
+def process_block_header(state, block) -> None:
+    _require(block.slot == state.slot, "block slot mismatch")
+    _require(block.slot > state.latest_block_header.slot,
+             "block older than latest header")
+    _require(block.proposer_index == get_beacon_proposer_index(state),
+             "wrong proposer index")
+    _require(block.parent_root == state.latest_block_header.root(),
+             "parent root mismatch")
+    state.latest_block_header = BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=b"\x00" * 32,
+        body_root=type(block.body).hash_tree_root(block.body),
+    )
+    proposer = state.validators[block.proposer_index]
+    _require(not proposer.slashed, "proposer is slashed")
+
+
+def process_randao(state, body, verify: bool = True) -> None:
+    cfg = beacon_config()
+    epoch = get_current_epoch(state)
+    if verify:
+        proposer = state.validators[get_beacon_proposer_index(state)]
+        domain = get_domain(state, cfg.domain_randao)
+        from ..ssz import uint64
+
+        root = compute_signing_root(_Uint64Box(epoch), domain)
+        ok = bls.Signature.from_bytes(body.randao_reveal).verify(
+            bls.PublicKey.from_bytes(proposer.pubkey), root)
+        _require(ok, "invalid randao reveal")
+    mix = _xor32(get_randao_mix(state, epoch, cfg),
+                 hashlib.sha256(body.randao_reveal).digest())
+    state.randao_mixes[epoch % cfg.epochs_per_historical_vector] = mix
+
+
+class _Uint64Box:
+    """SSZ-root of a bare uint64 (epoch signing per spec)."""
+
+    def __init__(self, v: int):
+        self.v = v
+
+    def root(self) -> bytes:
+        return int(self.v).to_bytes(8, "little").ljust(32, b"\x00")
+
+
+def _xor32(a: bytes, b: bytes) -> bytes:
+    return bytes(x ^ y for x, y in zip(a, b))
+
+
+def process_eth1_data(state, body, types) -> None:
+    cfg = beacon_config()
+    state.eth1_data_votes.append(body.eth1_data)
+    period_len = cfg.slots_per_eth1_voting_period()
+    votes = [v for v in state.eth1_data_votes if v == body.eth1_data]
+    if len(votes) * 2 > period_len:
+        state.eth1_data = body.eth1_data
+
+
+def process_proposer_slashing(state, slashing) -> None:
+    h1 = slashing.signed_header_1.message
+    h2 = slashing.signed_header_2.message
+    _require(h1.slot == h2.slot, "headers not for same slot")
+    _require(h1.proposer_index == h2.proposer_index,
+             "headers not by same proposer")
+    _require(h1 != h2, "headers are identical")
+    _require(h1.proposer_index < len(state.validators), "unknown proposer")
+    proposer = state.validators[h1.proposer_index]
+    _require(is_slashable_validator(proposer, get_current_epoch(state)),
+             "proposer not slashable")
+    cfg = beacon_config()
+    for signed in (slashing.signed_header_1, slashing.signed_header_2):
+        domain = get_domain(
+            state, cfg.domain_beacon_proposer,
+            compute_epoch_at_slot(signed.message.slot))
+        root = compute_signing_root(signed.message, domain)
+        ok = bls.Signature.from_bytes(signed.signature).verify(
+            bls.PublicKey.from_bytes(proposer.pubkey), root)
+        _require(ok, "invalid proposer slashing signature")
+    slash_validator(state, h1.proposer_index)
+
+
+def process_attester_slashing(state, slashing) -> None:
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    _require(is_slashable_attestation_data(a1.data, a2.data),
+             "attestations not slashable")
+    _require(is_valid_indexed_attestation(state, a1),
+             "attestation_1 invalid")
+    _require(is_valid_indexed_attestation(state, a2),
+             "attestation_2 invalid")
+    slashed_any = False
+    common = (set(a1.attesting_indices)
+              & set(a2.attesting_indices))
+    for index in sorted(common):
+        if is_slashable_validator(state.validators[index],
+                                  get_current_epoch(state)):
+            slash_validator(state, index)
+            slashed_any = True
+    _require(slashed_any, "no validator slashed")
+
+
+def process_attestation(state, attestation: Attestation,
+                        verify_signature: bool = True) -> None:
+    cfg = beacon_config()
+    data = attestation.data
+    _require(data.target.epoch in
+             (get_previous_epoch(state), get_current_epoch(state)),
+             "target epoch not current or previous")
+    _require(data.target.epoch == compute_epoch_at_slot(data.slot),
+             "target epoch does not match slot")
+    _require(data.slot + cfg.min_attestation_inclusion_delay
+             <= state.slot, "attestation too new")
+    _require(state.slot
+             <= data.slot + cfg.slots_per_epoch, "attestation too old")
+    _require(data.index
+             < get_committee_count_per_slot(state, data.target.epoch),
+             "committee index out of range")
+    committee = get_beacon_committee(state, data.slot, data.index)
+    _require(len(attestation.aggregation_bits) == len(committee),
+             "aggregation bits length mismatch")
+
+    pending = PendingAttestation(
+        aggregation_bits=list(attestation.aggregation_bits),
+        data=data,
+        inclusion_delay=state.slot - data.slot,
+        proposer_index=get_beacon_proposer_index(state),
+    )
+    if data.target.epoch == get_current_epoch(state):
+        _require(data.source == state.current_justified_checkpoint,
+                 "source does not match current justified")
+        state.current_epoch_attestations.append(pending)
+    else:
+        _require(data.source == state.previous_justified_checkpoint,
+                 "source does not match previous justified")
+        state.previous_epoch_attestations.append(pending)
+
+    if verify_signature:
+        indexed = get_indexed_attestation(state, attestation)
+        _require(is_valid_indexed_attestation(state, indexed),
+                 "invalid attestation signature")
+
+
+def is_valid_merkle_branch(leaf: bytes, branch, depth: int, index: int,
+                           root: bytes) -> bool:
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = hashlib.sha256(branch[i] + value).digest()
+        else:
+            value = hashlib.sha256(value + branch[i]).digest()
+    return value == root
+
+
+def process_deposit(state, deposit) -> None:
+    from ..proto import DEPOSIT_CONTRACT_TREE_DEPTH
+
+    cfg = beacon_config()
+    leaf = DepositData.hash_tree_root(deposit.data)
+    _require(is_valid_merkle_branch(
+        leaf, deposit.proof, DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+        state.eth1_deposit_index, state.eth1_data.deposit_root),
+        "invalid deposit merkle proof")
+    state.eth1_deposit_index += 1
+
+    pubkey = deposit.data.pubkey
+    amount = deposit.data.amount
+    known = {v.pubkey: i for i, v in enumerate(state.validators)}
+    if pubkey not in known:
+        # proof of possession: invalid signature -> deposit skipped
+        message = DepositMessage(
+            pubkey=pubkey,
+            withdrawal_credentials=deposit.data.withdrawal_credentials,
+            amount=amount)
+        domain = compute_domain(cfg.domain_deposit)
+        root = compute_signing_root(message, domain)
+        try:
+            sig = bls.Signature.from_bytes(deposit.data.signature)
+            pk = bls.PublicKey.from_bytes(pubkey)
+        except ValueError:
+            return
+        if not sig.verify(pk, root):
+            return
+        from ..proto import Validator
+
+        eff = min(amount - amount % cfg.effective_balance_increment,
+                  cfg.max_effective_balance)
+        state.validators.append(Validator(
+            pubkey=pubkey,
+            withdrawal_credentials=deposit.data.withdrawal_credentials,
+            effective_balance=eff,
+            slashed=False,
+            activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+            activation_epoch=FAR_FUTURE_EPOCH,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        ))
+        state.balances.append(amount)
+    else:
+        increase_balance(state, known[pubkey], amount)
+
+
+def process_voluntary_exit(state, signed_exit) -> None:
+    cfg = beacon_config()
+    exit_msg = signed_exit.message
+    _require(exit_msg.validator_index < len(state.validators),
+             "unknown validator")
+    validator = state.validators[exit_msg.validator_index]
+    epoch = get_current_epoch(state)
+    _require(validator.activation_epoch <= epoch < validator.exit_epoch,
+             "validator not active")
+    _require(validator.exit_epoch == FAR_FUTURE_EPOCH,
+             "exit already initiated")
+    _require(epoch >= exit_msg.epoch, "exit not yet valid")
+    _require(epoch >= validator.activation_epoch
+             + cfg.shard_committee_period,
+             "validator too young to exit")
+    domain = get_domain(state, cfg.domain_voluntary_exit, exit_msg.epoch)
+    root = compute_signing_root(exit_msg, domain)
+    ok = bls.Signature.from_bytes(signed_exit.signature).verify(
+        bls.PublicKey.from_bytes(validator.pubkey), root)
+    _require(ok, "invalid voluntary exit signature")
+    initiate_validator_exit(state, exit_msg.validator_index)
+
+
+def process_operations(state, body, verify_signatures: bool = True
+                       ) -> None:
+    cfg = beacon_config()
+    expected_deposits = min(
+        cfg.max_deposits,
+        state.eth1_data.deposit_count - state.eth1_deposit_index)
+    _require(len(body.deposits) == expected_deposits,
+             "wrong deposit count")
+    for op in body.proposer_slashings:
+        process_proposer_slashing(state, op)
+    for op in body.attester_slashings:
+        process_attester_slashing(state, op)
+    for op in body.attestations:
+        process_attestation(state, op, verify_signature=verify_signatures)
+    for op in body.deposits:
+        process_deposit(state, op)
+    for op in body.voluntary_exits:
+        process_voluntary_exit(state, op)
+
+
+def process_block(state, block, types, verify_signatures: bool = True
+                  ) -> None:
+    process_block_header(state, block)
+    process_randao(state, block.body, verify=verify_signatures)
+    process_eth1_data(state, block.body, types)
+    process_operations(state, block.body,
+                       verify_signatures=verify_signatures)
+
+
+def state_transition(state, signed_block, types=None,
+                     validate_result: bool = True,
+                     verify_signatures: bool = True):
+    """ExecuteStateTransition analog: slots -> block -> state-root
+    check.  Mutates ``state`` in place; raises StateTransitionError on
+    any invalid input."""
+    from ..proto import active_types
+
+    types = types or active_types()
+    block = signed_block.message
+    process_slots(state, block.slot, types)
+    if verify_signatures:
+        _require(verify_block_signature(state, signed_block),
+                 "invalid block signature")
+    process_block(state, block, types, verify_signatures=verify_signatures)
+    if validate_result:
+        _require(block.state_root
+                 == types.BeaconState.hash_tree_root(state),
+                 "post-state root mismatch")
+    return state
+
+
+def collect_block_signature_batch(state, signed_block) -> "bls.SignatureBatch":
+    """AttestationSignatureBatch / BatchVerifier analog: gather the
+    block's proposer, randao, and attestation signature work into one
+    SignatureBatch for a single TPU dispatch (callers then run
+    state_transition with verify_signatures=False)."""
+    cfg = beacon_config()
+    batch = bls.SignatureBatch()
+    block = signed_block.message
+    proposer = state.validators[block.proposer_index]
+    domain = get_domain(state, cfg.domain_beacon_proposer)
+    batch.add(bls.Signature.from_bytes(signed_block.signature),
+              compute_signing_root(block, domain),
+              bls.PublicKey.from_bytes(proposer.pubkey), "block proposer")
+
+    epoch = compute_epoch_at_slot(block.slot)
+    randao_domain = get_domain(state, cfg.domain_randao, epoch)
+    batch.add(bls.Signature.from_bytes(block.body.randao_reveal),
+              compute_signing_root(_Uint64Box(epoch), randao_domain),
+              bls.PublicKey.from_bytes(proposer.pubkey), "randao")
+
+    for att in block.body.attestations:
+        indexed = get_indexed_attestation(state, att)
+        pks = [bls.PublicKey.from_bytes(state.validators[i].pubkey)
+               for i in indexed.attesting_indices]
+        att_domain = get_domain(state, cfg.domain_beacon_attester,
+                                att.data.target.epoch)
+        root = compute_signing_root(att.data, att_domain)
+        batch.add(bls.Signature.from_bytes(att.signature), root,
+                  bls.PublicKey.aggregate(pks), "attestation")
+    return batch

@@ -1,0 +1,58 @@
+"""Validator mutators: exits and slashing.
+
+Reference analog: ``beacon-chain/core/validators`` (InitiateValidatorExit,
+SlashValidator) [U, SURVEY.md §2]."""
+
+from __future__ import annotations
+
+from ..config import beacon_config
+from .helpers import (
+    FAR_FUTURE_EPOCH, compute_activation_exit_epoch, decrease_balance,
+    get_beacon_proposer_index, get_current_epoch, get_validator_churn_limit,
+    increase_balance,
+)
+
+
+def initiate_validator_exit(state, index: int, cfg=None) -> None:
+    cfg = cfg or beacon_config()
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_epochs = [w.exit_epoch for w in state.validators
+                   if w.exit_epoch != FAR_FUTURE_EPOCH]
+    exit_queue_epoch = max(
+        exit_epochs
+        + [compute_activation_exit_epoch(get_current_epoch(state), cfg)])
+    exit_queue_churn = sum(
+        1 for w in state.validators if w.exit_epoch == exit_queue_epoch)
+    if exit_queue_churn >= get_validator_churn_limit(state, cfg):
+        exit_queue_epoch += 1
+    v.exit_epoch = exit_queue_epoch
+    v.withdrawable_epoch = (exit_queue_epoch
+                            + cfg.min_validator_withdrawability_delay)
+
+
+def slash_validator(state, slashed_index: int,
+                    whistleblower_index: int | None = None,
+                    cfg=None) -> None:
+    cfg = cfg or beacon_config()
+    epoch = get_current_epoch(state)
+    initiate_validator_exit(state, slashed_index, cfg)
+    v = state.validators[slashed_index]
+    v.slashed = True
+    v.withdrawable_epoch = max(
+        v.withdrawable_epoch, epoch + cfg.epochs_per_slashings_vector)
+    state.slashings[epoch % cfg.epochs_per_slashings_vector] += (
+        v.effective_balance)
+    decrease_balance(state, slashed_index,
+                     v.effective_balance // cfg.min_slashing_penalty_quotient)
+
+    proposer_index = get_beacon_proposer_index(state, cfg)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = (v.effective_balance
+                            // cfg.whistleblower_reward_quotient)
+    proposer_reward = whistleblower_reward // cfg.proposer_reward_quotient
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(state, whistleblower_index,
+                     whistleblower_reward - proposer_reward)

@@ -1,0 +1,463 @@
+"""BLS facade: the impl-agnostic seam (reference crypto/bls/bls.go +
+common/ [U, SURVEY.md §2 "BLS interface"]).
+
+``PublicKey`` / ``Signature`` / ``SecretKey`` wrap the ZCash wire
+format; heavy verification dispatches on
+``features().bls_implementation``:
+
+  pure — trusted host golden model (reference's herumi role)
+  xla  — JAX/TPU batch backend   (reference's blst role + the
+         north-star jax implementation)
+
+``SignatureBatch`` accumulates (sig, msg, pk) triples — the structure
+the reference threads from block processing and the attestation pool
+into ``VerifyMultipleSignatures`` — and verifies them all with one
+randomized-linear-combination pairing check on device.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ...config import features
+from .params import ETH2_DST, R
+from .pure import signature as ps
+from .pure import curve as pc
+
+POP_DST = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+
+class SecretKey:
+    """Scalar in [1, r).  KeyGen mirrors deterministic test keys; real
+    keystores land with the validator client (EIP-2335)."""
+
+    __slots__ = ("_k",)
+
+    def __init__(self, k: int):
+        k %= R
+        if k == 0:
+            raise ValueError("secret key must be nonzero mod r")
+        self._k = k
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SecretKey":
+        if len(data) != 32:
+            raise ValueError("secret key must be 32 bytes")
+        return cls(int.from_bytes(data, "big"))
+
+    def to_bytes(self) -> bytes:
+        return self._k.to_bytes(32, "big")
+
+    def public_key(self) -> "PublicKey":
+        return PublicKey(point=ps.sk_to_pubkey_point(self._k))
+
+    def sign(self, msg: bytes, dst: bytes = ETH2_DST) -> "Signature":
+        return Signature(point=ps.sign_point(self._k, msg, dst))
+
+    def pop_prove(self) -> "Signature":
+        """Proof of possession: sign the serialized pubkey, POP DST."""
+        return self.sign(self.public_key().to_bytes(), dst=POP_DST)
+
+
+class PublicKey:
+    __slots__ = ("_pt", "_bytes")
+
+    def __init__(self, point=None, raw: bytes | None = None):
+        self._pt = point
+        self._bytes = raw
+
+    @classmethod
+    def from_bytes(cls, data: bytes, validate: bool = True) -> "PublicKey":
+        pt = ps.g1_from_bytes(data, subgroup_check=validate)
+        if validate and pt is None:
+            raise ValueError("infinity public key rejected")
+        return cls(point=pt, raw=bytes(data))
+
+    def to_bytes(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = ps.g1_to_bytes(self._pt)
+        return self._bytes
+
+    @property
+    def point(self):
+        return self._pt
+
+    def __eq__(self, o):
+        return isinstance(o, PublicKey) and self.to_bytes() == o.to_bytes()
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+    @staticmethod
+    def aggregate(pubkeys: list["PublicKey"]) -> "PublicKey":
+        if not pubkeys:
+            raise ValueError("cannot aggregate empty pubkey list")
+        return PublicKey(
+            point=ps.aggregate_points([p.point for p in pubkeys]))
+
+
+class Signature:
+    __slots__ = ("_pt", "_bytes")
+
+    def __init__(self, point=None, raw: bytes | None = None):
+        self._pt = point
+        self._bytes = raw
+
+    @classmethod
+    def from_bytes(cls, data: bytes, validate: bool = True) -> "Signature":
+        pt = ps.g2_from_bytes(data, subgroup_check=validate)
+        return cls(point=pt, raw=bytes(data))
+
+    def to_bytes(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = ps.g2_to_bytes(self._pt)
+        return self._bytes
+
+    @property
+    def point(self):
+        return self._pt
+
+    def __eq__(self, o):
+        return isinstance(o, Signature) and self.to_bytes() == o.to_bytes()
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+    @staticmethod
+    def aggregate(sigs: list["Signature"]) -> "Signature":
+        if not sigs:
+            raise ValueError("cannot aggregate empty signature list")
+        return Signature(
+            point=ps.aggregate_points([s.point for s in sigs]))
+
+    # --- verification (dispatching) ---------------------------------------
+
+    def verify(self, pk: PublicKey, msg: bytes,
+               dst: bytes = ETH2_DST) -> bool:
+        return _backend().verify(pk.point, msg, self._pt, dst)
+
+    def fast_aggregate_verify(self, pks: list[PublicKey], msg: bytes,
+                              dst: bytes = ETH2_DST) -> bool:
+        if not pks:
+            return False
+        return _backend().fast_aggregate_verify(
+            [p.point for p in pks], msg, self._pt, dst)
+
+    def aggregate_verify(self, pks: list[PublicKey], msgs: list[bytes],
+                         dst: bytes = ETH2_DST) -> bool:
+        if not pks or len(pks) != len(msgs):
+            return False
+        return _backend().aggregate_verify(
+            [p.point for p in pks], msgs, self._pt, dst)
+
+
+def pop_verify(pk: PublicKey, proof: Signature) -> bool:
+    """Verify a proof of possession (deposit-processing dependency)."""
+    return proof.verify(pk, pk.to_bytes(), dst=POP_DST)
+
+
+# --- SignatureBatch --------------------------------------------------------
+
+
+@dataclass
+class SignatureBatch:
+    """The reference's SignatureBatch {signatures, messages, publicKeys}
+    with Join; verified in one RLC pairing check."""
+
+    signatures: list[Signature] = field(default_factory=list)
+    messages: list[bytes] = field(default_factory=list)
+    public_keys: list[PublicKey] = field(default_factory=list)
+    descriptions: list[str] = field(default_factory=list)
+
+    def add(self, sig: Signature, msg: bytes, pk: PublicKey,
+            desc: str = "") -> None:
+        self.signatures.append(sig)
+        self.messages.append(msg)
+        self.public_keys.append(pk)
+        self.descriptions.append(desc)
+
+    def join(self, other: "SignatureBatch") -> "SignatureBatch":
+        self.signatures.extend(other.signatures)
+        self.messages.extend(other.messages)
+        self.public_keys.extend(other.public_keys)
+        self.descriptions.extend(other.descriptions)
+        return self
+
+    def __len__(self) -> int:
+        return len(self.signatures)
+
+    def verify(self, rng=None) -> bool:
+        return verify_multiple_signatures(self, rng=rng)
+
+
+def verify_multiple_signatures(batch: SignatureBatch, rng=None) -> bool:
+    """Randomized-linear-combination batch verify (reference
+    crypto/bls VerifyMultipleSignatures [U]): sound up to 2^-63 per
+    random scalar; a single tampered entry fails the whole check."""
+    if len(batch) == 0:
+        return True
+    if any(s.point is None for s in batch.signatures):
+        return False
+    if any(p.point is None for p in batch.public_keys):
+        return False
+    return _backend().verify_multiple(
+        [s.point for s in batch.signatures], list(batch.messages),
+        [p.point for p in batch.public_keys], rng)
+
+
+# --- backends --------------------------------------------------------------
+
+
+class _PureBackend:
+    """Host golden model (reference's second implementation role)."""
+
+    @staticmethod
+    def verify(pk_pt, msg, sig_pt, dst):
+        return ps.verify_points(pk_pt, msg, sig_pt, dst)
+
+    @staticmethod
+    def fast_aggregate_verify(pk_pts, msg, sig_pt, dst):
+        return ps.fast_aggregate_verify_points(pk_pts, msg, sig_pt, dst)
+
+    @staticmethod
+    def aggregate_verify(pk_pts, msgs, sig_pt, dst):
+        return ps.aggregate_verify_points(pk_pts, msgs, sig_pt, dst)
+
+    @staticmethod
+    def verify_multiple(sig_pts, msgs, pk_pts, rng):
+        if rng is None:
+            rng = np.random.default_rng()
+        from .pure.fields import Fq12
+        from .pure.pairing import multi_pairing
+
+        rs = [int(rng.integers(1, 1 << 63)) | 1 for _ in sig_pts]
+        s = None
+        for r, sig in zip(rs, sig_pts):
+            s = pc.add(s, pc.multiply(sig, r))
+        pairs = [(pc.neg(pc.G1_GEN), s)]
+        from .pure.hash_to_curve import hash_to_g2
+
+        for r, pk, msg in zip(rs, pk_pts, msgs):
+            pairs.append((pc.multiply(pk, r), hash_to_g2(msg, ETH2_DST)))
+        return multi_pairing(pairs) == Fq12.one()
+
+
+def _bucket(n: int, floor: int = 4) -> int:
+    """Round a batch size up to a power of two so jit caches are shared
+    across nearby sizes (padding entries are masked out)."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class _XlaBackend:
+    """JAX/TPU backend (the north-star third implementation)."""
+
+    @staticmethod
+    def verify(pk_pt, msg, sig_pt, dst):
+        if pk_pt is None or sig_pt is None:
+            return False
+        return _XlaBackend.aggregate_verify([pk_pt], [msg], sig_pt, dst)
+
+    @staticmethod
+    def fast_aggregate_verify(pk_pts, msg, sig_pt, dst):
+        if sig_pt is None or not pk_pts or any(
+                p is None for p in pk_pts):
+            return False
+        from .xla import h2c
+        from .xla.curve import pack_g1_points, pack_g2_points
+        from .xla.verify import fast_aggregate_verify_device
+
+        # pad with infinity points: they are additive identities in the
+        # pubkey sum, so no mask is needed
+        nb = _bucket(len(pk_pts))
+        pk_jac = pack_g1_points(
+            list(pk_pts) + [None] * (nb - len(pk_pts)))
+        h = h2c.hash_to_g2([msg], dst)
+        h_single = tuple(t[0] for t in h)
+        sig_x, sig_y, _ = pack_g2_points([sig_pt])
+        out = fast_aggregate_verify_device(
+            pk_jac, h_single, (sig_x[0], sig_y[0]))
+        return bool(out)
+
+    @staticmethod
+    def aggregate_verify(pk_pts, msgs, sig_pt, dst):
+        if sig_pt is None or not pk_pts or any(
+                p is None for p in pk_pts):
+            return False
+        import jax.numpy as jnp
+
+        from .xla import h2c
+        from .xla.curve import (
+            g1_to_affine, pack_g1_points, pack_g2_points,
+        )
+        from .xla.verify import aggregate_verify_device
+
+        n = len(pk_pts)
+        nb = _bucket(n)
+        pad = nb - n
+        pk_jac = pack_g1_points(list(pk_pts) + [pc.G1_GEN] * pad)
+        pk_x, pk_y, pk_inf = g1_to_affine(pk_jac)
+        h = h2c.hash_to_g2(list(msgs) + [b""] * pad, dst)
+        sig_x, sig_y, _ = pack_g2_points([sig_pt])
+        live = jnp.arange(nb) < n
+        out = aggregate_verify_device(
+            (pk_x, pk_y), h, (sig_x[0], sig_y[0]), ~pk_inf & live)
+        return bool(out)
+
+    @staticmethod
+    def verify_multiple(sig_pts, msgs, pk_pts, rng):
+        import jax.numpy as jnp
+
+        from .xla import h2c
+        from .xla.curve import pack_g1_points, pack_g2_points
+        from .xla.verify import random_rlc_bits, rlc_batch_verify_device
+
+        n = len(sig_pts)
+        nb = _bucket(n)
+        pad = nb - n
+        pk_jac = pack_g1_points(list(pk_pts) + [pc.G1_GEN] * pad)
+        sx, sy, sz = pack_g2_points(list(sig_pts) + [pc.G2_GEN] * pad)
+        h = h2c.hash_to_g2(list(msgs) + [b""] * pad, ETH2_DST)
+        r_bits = random_rlc_bits(nb, rng)
+        mask = jnp.arange(nb) < n
+        return bool(rlc_batch_verify_device(
+            pk_jac, (sx, sy, sz), h, r_bits, mask))
+
+
+_BACKENDS = {"pure": _PureBackend, "xla": _XlaBackend}
+
+
+def _backend():
+    name = features().bls_implementation
+    try:
+        return _BACKENDS[name]
+    except KeyError:
+        raise ValueError(f"unknown bls implementation {name!r}") from None
+
+
+# --- deterministic test keys (testing/util analog) -------------------------
+
+
+def deterministic_keypair(index: int) -> tuple[SecretKey, PublicKey]:
+    sk = SecretKey(ps.deterministic_secret_key(index))
+    return sk, sk.public_key()
+
+
+# --- bench / driver hooks --------------------------------------------------
+
+
+def build_synthetic_slot_batch(n_committees: int, committee_size: int):
+    """A synthetic mainnet slot: one aggregated attestation signature
+    per committee over a distinct 32-byte root (deterministic keys)."""
+    import jax.numpy as jnp
+
+    from .xla import h2c
+    from .xla.curve import pack_g1_points, pack_g2_points
+    from .xla.verify import random_rlc_bits
+
+    pk_pts, sig_pts, msgs = [], [], []
+    for c in range(n_committees):
+        msg = hashlib.sha256(b"attestation-root-%d" % c).digest()
+        sks = [ps.deterministic_secret_key(c * committee_size + i)
+               for i in range(committee_size)]
+        # one signer's sig scaled by the sum of secret keys equals the
+        # aggregate: sigma = [sum sk_i] H(m) — build it cheaply with a
+        # single pure scalar-mul instead of committee_size signs
+        total = sum(sks) % R
+        from .pure.hash_to_curve import hash_to_g2 as pure_h2g2
+
+        hpt = pure_h2g2(msg, ETH2_DST)
+        sig_pts.append(pc.multiply(hpt, total))
+        pk_pts.append([ps.sk_to_pubkey_point(sk) for sk in sks])
+        msgs.append(msg)
+
+    flat_pks = [p for row in pk_pts for p in row]
+    pk_jac = pack_g1_points(flat_pks)
+    pk_jac = tuple(
+        t.reshape((n_committees, committee_size) + t.shape[1:])
+        for t in pk_jac)
+    sig_jac = pack_g2_points(sig_pts)
+    h_jac = h2c.hash_to_g2(msgs, ETH2_DST)
+    r_bits = random_rlc_bits(n_committees, np.random.default_rng(7))
+    return {"pk_jac": pk_jac, "sig_jac": sig_jac, "h_jac": h_jac,
+            "r_bits": r_bits, "n_committees": n_committees,
+            "committee_size": committee_size}
+
+
+def compiled_slot_verify(batch):
+    """(fn, args) for BASELINE config #3: one device dispatch verifying
+    the whole slot (per-committee pk aggregation + RLC pairing)."""
+    from .xla.verify import slot_verify_device
+
+    args = (batch["pk_jac"], batch["sig_jac"], batch["h_jac"],
+            batch["r_bits"])
+    return slot_verify_device, args
+
+
+def compiled_fast_aggregate_verify(n_pubkeys: int):
+    """(fn, args) for BASELINE config #2."""
+    from .xla import h2c
+    from .xla.curve import pack_g1_points, pack_g2_points
+    from .xla.verify import fast_aggregate_verify_device
+
+    msg = hashlib.sha256(b"aggregate-root").digest()
+    sks = [ps.deterministic_secret_key(i) for i in range(n_pubkeys)]
+    from .pure.hash_to_curve import hash_to_g2 as pure_h2g2
+
+    hpt = pure_h2g2(msg, ETH2_DST)
+    sig = pc.multiply(hpt, sum(sks) % R)
+    pk_jac = pack_g1_points([ps.sk_to_pubkey_point(sk) for sk in sks])
+    h = h2c.hash_to_g2([msg], ETH2_DST)
+    h_single = tuple(t[0] for t in h)
+    sx, sy, _ = pack_g2_points([sig])
+    return fast_aggregate_verify_device, (pk_jac, h_single,
+                                          (sx[0], sy[0]))
+
+
+def compiled_single_verify():
+    """(fn, args) for BASELINE config #1."""
+    from .xla import h2c
+    from .xla.curve import g1_to_affine, pack_g1_points, pack_g2_points
+    from .xla.verify import aggregate_verify_device
+    import jax.numpy as jnp
+
+    sk, pk = deterministic_keypair(0)
+    msg = hashlib.sha256(b"single-verify").digest()
+    sig = sk.sign(msg)
+    pk_jac = pack_g1_points([pk.point])
+    pk_x, pk_y, pk_inf = g1_to_affine(pk_jac)
+    h = h2c.hash_to_g2([msg], ETH2_DST)
+    sx, sy, _ = pack_g2_points([sig.point])
+    return aggregate_verify_device, ((pk_x, pk_y), h, (sx[0], sy[0]),
+                                     ~pk_inf)
+
+
+def graft_entry_fn():
+    """Driver contract: jittable forward step on the flagship model —
+    a 4-committee x 8-validator slot verification."""
+    batch = build_synthetic_slot_batch(n_committees=4, committee_size=8)
+    return compiled_slot_verify(batch)
+
+
+def dryrun_slot_pipeline(mesh) -> None:
+    """Driver contract: jit the slot pipeline over a device mesh (data
+    parallel over the committee axis) and run one tiny step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as Pspec
+
+    from .xla import tower as xtower
+    from .xla.verify import sharded_slot_verify
+
+    n_dev = mesh.devices.size
+    batch = build_synthetic_slot_batch(n_committees=n_dev * 2,
+                                       committee_size=2)
+    ok = sharded_slot_verify(mesh, batch["pk_jac"], batch["sig_jac"],
+                             batch["h_jac"], batch["r_bits"])
+    assert bool(ok), "sharded slot verification rejected a valid slot"

@@ -204,7 +204,14 @@ def clear_cofactor_g2(pt):
     return multiply(pt, H_EFF_G2)
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=4096)
 def hash_to_g2(msg: bytes, dst: bytes):
+    """Cached: committees sign the same root, so aggregate fixtures and
+    batch pipelines hit the same (msg, dst) many times; points are
+    immutable tuples, safe to share."""
     u0, u1 = hash_to_field_fq2(msg, 2, dst)
     q0 = iso_map_to_e2(map_to_curve_sswu(u0))
     q1 = iso_map_to_e2(map_to_curve_sswu(u1))

@@ -148,6 +148,23 @@ def scalar_mul(ops: FieldOps, pt, scalar_bits):
     return out
 
 
+def scalar_mul_static(ops: FieldOps, pt, e: int):
+    """[e]P for a static Python-int scalar, via lax.scan over the bit
+    string with a lax.cond add-step (the cofactor-clearing shape)."""
+    bits = jnp.asarray(L._bits_msb_first(e))
+
+    def body(acc, bit):
+        acc = point_double(ops, acc)
+        acc = lax.cond(bit == 1,
+                       lambda a: point_add(ops, a, pt),
+                       lambda a: a, acc)
+        return acc, None
+
+    # leading bit is 1: start from P
+    out, _ = lax.scan(body, pt, bits[1:])
+    return out
+
+
 def point_inf_like(ops: FieldOps, pt):
     """(1, 1, 0) in Montgomery form, shaped/sharded like pt (built from
     the operand so varying axes survive shard_map)."""

@@ -1,0 +1,178 @@
+"""Hash-to-G2 for BLS12-381 on device (suite BLS12381G2_XMD:SHA-256_SSWU_RO_).
+
+Reference analog: blst's hash_to_G2 (crypto/bls L0 [U, SURVEY.md §2]).
+
+Split per SURVEY.md §7 stage 4: the byte-oriented part
+(expand_message_xmd over SHA-256 -> field element ints) runs on the
+host with hashlib — it is a few microseconds per message; everything
+heavy (SSWU map, 3-isogeny, cofactor clearing by the 636-bit h_eff)
+runs batched on device, so an aggregate-verify path has no
+per-signature pure-Python hot loop.
+
+Branchless SSWU (RFC 9380 §6.6.2) notes:
+* is_square(gx1) via the Legendre symbol of the Fq2 norm in Fp (one
+  381-bit Fp pow scan).
+* sqrt in Fq2 via the p%4==3 complex method (two 381-bit Fq2 pow
+  scans); the alpha == -1 branch resolves by select, and the "other"
+  branch's pow of zero is harmlessly zero.
+* sgn0 parity checks need canonical (non-Montgomery) residues — one
+  from_mont per coefficient.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..params import H_EFF_G2, P
+from ..pure import hash_to_curve as pure_h2c
+from ..pure.fields import Fq2
+from . import limbs as L
+from . import tower as T
+from .curve import FQ2_OPS, point_add, scalar_mul_static
+
+# --- constants (Montgomery Fq2, packed host-side once) ---------------------
+
+ISO_A = T._host_mont_fq2([pure_h2c.ISO_A])[0]
+ISO_B = T._host_mont_fq2([pure_h2c.ISO_B])[0]
+Z_SSWU = T._host_mont_fq2([pure_h2c.Z_SSWU])[0]
+XNUM = T._host_mont_fq2(pure_h2c._XNUM)
+XDEN = T._host_mont_fq2(pure_h2c._XDEN)
+YNUM = T._host_mont_fq2(pure_h2c._YNUM)
+YDEN = T._host_mont_fq2(pure_h2c._YDEN)
+
+
+# --- Fq2 square root / squareness (branchless) -----------------------------
+
+
+@jax.jit
+def fq2_is_square(a):
+    """Legendre symbol of norm(a) = c0^2 + c1^2 in Fp != -1."""
+    t = L.fp_mul(a, a)  # coefficient axis as batch: c0^2, c1^2
+    norm = L.fp_add(t[..., 0, :], t[..., 1, :])
+    ls = L.fp_pow_fixed(norm, (P - 1) // 2)
+    minus_one = L.pack_ints([P - 1])[0]
+    return ~L.fp_eq(ls, jnp.broadcast_to(minus_one, ls.shape))
+
+
+@jax.jit
+def fq2_sqrt(a):
+    """Principal square root candidate (p^2 % 8 == 1 via the p % 4 == 3
+    complex method, mirroring pure.fields.Fq2.sqrt).  For non-residues
+    the returned value is garbage — callers guard with fq2_is_square.
+    sqrt(0) == 0."""
+    a1 = T.fq2_pow_fixed(a, (P - 3) // 4)
+    x0 = T.fq2_mul(a1, a)
+    alpha = T.fq2_mul(a1, x0)
+    # candidate if alpha == -1: i * x0 = (-x0_c1, x0_c0)
+    cand_i = jnp.stack([L.fp_neg(x0[..., 1, :]), x0[..., 0, :]], axis=-2)
+    b = T.fq2_pow_fixed(
+        T.fq2_add(alpha, T.fq2_one_like(alpha)), (P - 1) // 2)
+    cand_b = T.fq2_mul(b, x0)
+    minus_one = T._host_mont_fq2([Fq2.from_ints(P - 1, 0)])[0]
+    is_m1 = T.fq2_eq(alpha, jnp.broadcast_to(minus_one, alpha.shape))
+    return T.fq2_select(is_m1, cand_i, cand_b)
+
+
+@jax.jit
+def fq2_sgn0(a):
+    """RFC 9380 sgn0 for Fq2 (m=2): sign of c0, tie-broken by c1."""
+    c0 = L.from_mont(a[..., 0, :])
+    c1 = L.from_mont(a[..., 1, :])
+    sign0 = c0[..., 0] & 1
+    zero0 = jnp.all(c0 == 0, axis=-1)
+    sign1 = c1[..., 0] & 1
+    return sign0 | (zero0.astype(jnp.uint32) & sign1)
+
+
+# --- SSWU + isogeny --------------------------------------------------------
+
+
+@jax.jit
+def map_to_curve_sswu(u):
+    """Simplified SWU onto the isogenous curve E' (batched, branchless).
+
+    Mirrors pure.hash_to_curve.map_to_curve_sswu; every conditional is
+    a select."""
+    A = jnp.broadcast_to(ISO_A, u.shape)
+    B = jnp.broadcast_to(ISO_B, u.shape)
+    Z = jnp.broadcast_to(Z_SSWU, u.shape)
+    u2 = T.fq2_sqr(u)
+    zu2 = T.fq2_mul(Z, u2)
+    tv1 = T.fq2_add(T.fq2_sqr(zu2), zu2)           # Z^2 u^4 + Z u^2
+    x1num = T.fq2_mul(B, T.fq2_add(tv1, T.fq2_one_like(tv1)))
+    tv1_zero = T.fq2_is_zero(tv1)
+    x1den = T.fq2_select(tv1_zero, T.fq2_mul(A, Z),
+                         T.fq2_neg(T.fq2_mul(A, tv1)))
+    x1den2 = T.fq2_sqr(x1den)
+    x1den3 = T.fq2_mul(x1den2, x1den)
+    gx1num = T.fq2_add(
+        T.fq2_add(T.fq2_mul(T.fq2_sqr(x1num), x1num),
+                  T.fq2_mul(A, T.fq2_mul(x1num, x1den2))),
+        T.fq2_mul(B, x1den3))
+    sq1 = fq2_is_square(T.fq2_mul(gx1num, x1den3))
+
+    # x2 = Z u^2 x1 ; gx2 = (Z u^2)^3 gx1
+    zu2_3 = T.fq2_mul(T.fq2_sqr(zu2), zu2)
+    x_num = T.fq2_select(sq1, x1num, T.fq2_mul(zu2, x1num))
+    g_num = T.fq2_select(sq1, gx1num, T.fq2_mul(zu2_3, gx1num))
+
+    x = T.fq2_mul(x_num, T.fq2_inv(x1den))
+    # y = sqrt(g_num / x1den3) = sqrt(g_num * x1den3) / x1den3
+    y = T.fq2_mul(fq2_sqrt(T.fq2_mul(g_num, x1den3)),
+                  T.fq2_inv(x1den3))
+    flip = fq2_sgn0(u) != fq2_sgn0(y)
+    y = T.fq2_select(flip, T.fq2_neg(y), y)
+    return x, y
+
+
+def _horner(coeffs, x):
+    acc = jnp.broadcast_to(coeffs[-1], x.shape)
+    for c in coeffs[-2::-1]:
+        acc = T.fq2_add(T.fq2_mul(acc, x), jnp.broadcast_to(c, x.shape))
+    return acc
+
+
+@jax.jit
+def iso_map_to_e2(x, y):
+    """3-isogeny E' -> E (batched; denominators never vanish for SSWU
+    outputs — pure model asserts the same)."""
+    xnum = _horner(list(XNUM), x)
+    xden = _horner(list(XDEN), x)
+    ynum = _horner(list(YNUM), x)
+    yden = _horner(list(YDEN), x)
+    inv = T.fq2_inv(T.fq2_mul(xden, yden))
+    x_out = T.fq2_mul(T.fq2_mul(xnum, yden), inv)     # xnum/xden
+    y_out = T.fq2_mul(y, T.fq2_mul(T.fq2_mul(ynum, xden), inv))
+    return x_out, y_out
+
+
+@jax.jit
+def hash_to_g2_device(u0, u1):
+    """(u0, u1) field elements -> G2 point (Jacobian, cleared cofactor)."""
+    x0, y0 = map_to_curve_sswu(u0)
+    x1, y1 = map_to_curve_sswu(u1)
+    q0x, q0y = iso_map_to_e2(x0, y0)
+    q1x, q1y = iso_map_to_e2(x1, y1)
+    one = T.fq2_one_like(q0x)
+    r = point_add(FQ2_OPS, (q0x, q0y, one), (q1x, q1y, one))
+    return scalar_mul_static(FQ2_OPS, r, H_EFF_G2)
+
+
+def hash_to_field_host(msgs, dst: bytes):
+    """Host: expand_message_xmd + reduce -> packed (u0, u1) arrays."""
+    u0s, u1s = [], []
+    for msg in msgs:
+        u0, u1 = pure_h2c.hash_to_field_fq2(msg, 2, dst)
+        u0s.append(u0)
+        u1s.append(u1)
+    return T.pack_fq2(u0s), T.pack_fq2(u1s)
+
+
+def hash_to_g2(msgs, dst: bytes):
+    """Batched hash-to-G2: host hashing, device curve math.
+
+    Returns a Jacobian G2 device triple with batch shape (len(msgs),).
+    """
+    u0, u1 = hash_to_field_host(msgs, dst)
+    return hash_to_g2_device(u0, u1)

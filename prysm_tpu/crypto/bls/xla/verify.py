@@ -1,0 +1,195 @@
+"""Device BLS verification paths (min-pubkey-size: PK in G1, sig in G2).
+
+Reference analog: blst's CoreVerify / CoreAggregateVerify /
+MultipleSignaturesVerify (crypto/bls L0+L1 [U, SURVEY.md §2]).
+
+Every path reduces to ONE multi-pairing with a shared final
+exponentiation; batches of points stay on device end-to-end:
+
+  verify:                 e(-g1, sig) * e(pk, H(msg)) == 1
+  aggregate_verify:       e(-g1, sig) * prod_i e(pk_i, H(m_i)) == 1
+  fast_aggregate_verify:  pk := sum_i pk_i (device tree), then verify
+  rlc_batch_verify:       random r_i:  e(-g1, sum_i [r_i]sig_i) *
+                          prod_i e([r_i]pk_i, H(m_i)) == 1
+                          (the reference's VerifyMultipleSignatures
+                          random-linear-combination reduction)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..params import P
+from ..pure import curve as pc
+from ..pure.fields import Fq
+from . import limbs as L
+from . import tower as T
+from .curve import (
+    FP_OPS, FQ2_OPS, g1_to_affine, g2_to_affine, pack_g1_points,
+    point_sum_tree, scalar_mul, scalar_bits_from_ints, point_select,
+    point_inf_like,
+)
+from .pairing import (
+    final_exponentiation, fq12_prod_tree, is_fq12_one, miller_loop,
+)
+from . import tower
+
+NEG_G1_GEN = (pc.G1_GEN[0], -pc.G1_GEN[1])
+
+
+def _neg_g1_affine():
+    x, y, _ = pack_g1_points([NEG_G1_GEN])
+    return x[0], y[0]
+
+
+@jax.jit
+def _pairing_check(p_x, p_y, q_x, q_y, mask):
+    """prod of masked pairings == 1."""
+    f = miller_loop((p_x, p_y), (q_x, q_y))
+    f = T.fq12_select(mask, f, T.fq12_one_like(f))
+    out = final_exponentiation(fq12_prod_tree(f))
+    return is_fq12_one(out)
+
+
+@jax.jit
+def aggregate_verify_device(pk_aff, h_jac, sig_aff, pk_mask):
+    """e(-g1, sig) * prod_i e(pk_i, H_i)^mask_i == 1.
+
+    pk_aff: (x, y) Fp arrays (n, 24); h_jac: Jacobian G2 triple (n,);
+    sig_aff: (x, y) Fq2 arrays (2, 24); pk_mask: bool (n,)."""
+    hx, hy, h_inf = g2_to_affine(h_jac)
+    del h_inf  # H(m) is never infinity for valid suite output
+    ng_x, ng_y = _neg_g1_affine()
+    p_x = jnp.concatenate([ng_x[None], pk_aff[0]], axis=0)
+    p_y = jnp.concatenate([ng_y[None], pk_aff[1]], axis=0)
+    q_x = jnp.concatenate([sig_aff[0][None], hx], axis=0)
+    q_y = jnp.concatenate([sig_aff[1][None], hy], axis=0)
+    mask = jnp.concatenate(
+        [jnp.ones((1,), bool), pk_mask], axis=0)
+    return _pairing_check(p_x, p_y, q_x, q_y, mask)
+
+
+@jax.jit
+def fast_aggregate_verify_device(pk_jac_batch, h_jac, sig_aff):
+    """Aggregate the pubkeys on device, then a 2-pairing check.
+
+    pk_jac_batch: Jacobian G1 triple with leading batch axis (n,).
+    h_jac: Jacobian G2 triple, single point (no batch axis)."""
+    apk = point_sum_tree(FP_OPS, pk_jac_batch)
+    ax, ay, a_inf = g1_to_affine(tuple(t[None] for t in apk))
+    hx, hy, _ = g2_to_affine(h_jac)
+    valid = ~a_inf[0]
+    ng_x, ng_y = _neg_g1_affine()
+    p_x = jnp.stack([ng_x, ax[0]], axis=0)
+    p_y = jnp.stack([ng_y, ay[0]], axis=0)
+    q_x = jnp.stack([sig_aff[0], hx], axis=0)
+    q_y = jnp.stack([sig_aff[1], hy], axis=0)
+    mask = jnp.ones((2,), bool)
+    return _pairing_check(p_x, p_y, q_x, q_y, mask) & valid
+
+
+@jax.jit
+def rlc_batch_verify_device(pk_jac, sig_jac, h_jac, r_bits, mask):
+    """VerifyMultipleSignatures: one pairing check for n (sig, msg, pk)
+    triples via a random linear combination.
+
+    pk_jac/sig_jac/h_jac: Jacobian triples, batch (n,);
+    r_bits: uint32 (nbits, n) random scalars (MSB-first);
+    mask: bool (n,) — padding entries contribute nothing."""
+    # [r_i] sig_i, summed -> S
+    r_sigs = scalar_mul(FQ2_OPS, sig_jac, r_bits)
+    r_sigs = point_select(FQ2_OPS, mask, r_sigs,
+                          point_inf_like(FQ2_OPS, r_sigs))
+    s = point_sum_tree(FQ2_OPS, r_sigs)
+    sx, sy, s_inf = g2_to_affine(tuple(t[None] for t in s))
+    # [r_i] pk_i
+    r_pks = scalar_mul(FP_OPS, pk_jac, r_bits)
+    px, py, p_inf = g1_to_affine(r_pks)
+    hx, hy, _ = g2_to_affine(h_jac)
+
+    ng_x, ng_y = _neg_g1_affine()
+    p_x = jnp.concatenate([ng_x[None], px], axis=0)
+    p_y = jnp.concatenate([ng_y[None], py], axis=0)
+    q_x = jnp.concatenate([sx, hx], axis=0)
+    q_y = jnp.concatenate([sy, hy], axis=0)
+    full_mask = jnp.concatenate([~s_inf, mask & ~p_inf], axis=0)
+    return _pairing_check(p_x, p_y, q_x, q_y, full_mask)
+
+
+@jax.jit
+def slot_verify_device(pk_jac, sig_jac, h_jac, r_bits):
+    """BASELINE config #3 in one dispatch: per-committee pubkey
+    aggregation + RLC across committees + one pairing check.
+
+    pk_jac: Jacobian G1 triple, batch (C, K) — C committees of K
+    validators; sig_jac: aggregated signatures (C,); h_jac: message
+    hashes (C,); r_bits: uint32 (nbits, C)."""
+    # per-committee aggregate pubkey: tree-sum over the validator axis
+    pk_t = tuple(jnp.moveaxis(t, 1, 0) for t in pk_jac)   # (K, C, ...)
+    apk = point_sum_tree(FP_OPS, pk_t)                    # (C, ...)
+    # RLC
+    r_apk = scalar_mul(FP_OPS, apk, r_bits)
+    r_sig = scalar_mul(FQ2_OPS, sig_jac, r_bits)
+    s = point_sum_tree(FQ2_OPS, r_sig)
+    # affine + pairing
+    ax, ay, a_inf = g1_to_affine(r_apk)
+    sx, sy, s_inf = g2_to_affine(tuple(t[None] for t in s))
+    hx, hy, _ = g2_to_affine(h_jac)
+    ng_x, ng_y = _neg_g1_affine()
+    p_x = jnp.concatenate([ng_x[None], ax], axis=0)
+    p_y = jnp.concatenate([ng_y[None], ay], axis=0)
+    q_x = jnp.concatenate([sx, hx], axis=0)
+    q_y = jnp.concatenate([sy, hy], axis=0)
+    mask = jnp.concatenate([~s_inf, ~a_inf], axis=0)
+    return _pairing_check(p_x, p_y, q_x, q_y, mask)
+
+
+def sharded_slot_verify(mesh, pk_jac, sig_jac, h_jac, r_bits):
+    """Multi-chip slot verification: committees sharded over the mesh's
+    'sig' axis; each device aggregates its committees' pubkeys, applies
+    the RLC, and runs its Miller loops; partial Fq12 products and the
+    partial [r]sig sums combine across devices (all-gather over ICI),
+    with one replicated final exponentiation."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as Pspec
+
+    def local_work(pk, sig, h, rb):
+        # pk arrives as (K, C_local, ...): sum over the validator axis
+        apk = point_sum_tree(FP_OPS, pk)
+        r_apk = scalar_mul(FP_OPS, apk, rb)
+        r_sig = scalar_mul(FQ2_OPS, sig, rb)
+        s_part = point_sum_tree(FQ2_OPS, r_sig)
+        ax, ay, a_inf = g1_to_affine(r_apk)
+        hx, hy, _ = g2_to_affine(h)
+        f = miller_loop((ax, ay), (hx, hy))
+        f = T.fq12_select(~a_inf, f, T.fq12_one_like(f))
+        f_part = fq12_prod_tree(f)
+        return f_part[None], tuple(t[None] for t in s_part)
+
+    f_parts, s_parts = shard_map(
+        local_work, mesh=mesh,
+        in_specs=(Pspec(None, "sig"), Pspec("sig"), Pspec("sig"),
+                  Pspec(None, "sig")),
+        out_specs=(Pspec("sig"), Pspec("sig")),
+        check_vma=False,
+    )(tuple(jnp.moveaxis(t, 0, 1) for t in pk_jac), sig_jac, h_jac,
+      r_bits)
+    # combine: global [r]sig sum and global Fq12 product
+    s = point_sum_tree(FQ2_OPS, s_parts)
+    sx, sy, s_inf = g2_to_affine(tuple(t[None] for t in s))
+    ng_x, ng_y = _neg_g1_affine()
+    f_neg = miller_loop((ng_x[None], ng_y[None]), (sx, sy))
+    f = jnp.concatenate([f_parts, f_neg], axis=0)
+    out = final_exponentiation(fq12_prod_tree(f))
+    return is_fq12_one(out) & ~s_inf[0]
+
+
+def random_rlc_bits(n: int, rng=None, nbits: int = 64) -> jnp.ndarray:
+    """n random nonzero RLC scalars as MSB-first bit planes."""
+    if rng is None:
+        rng = np.random.default_rng()
+    scalars = [int(rng.integers(1, 1 << 63)) | 1 for _ in range(n)]
+    return scalar_bits_from_ints(scalars, nbits)

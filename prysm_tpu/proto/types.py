@@ -1,0 +1,302 @@
+"""Phase-0 consensus containers (SSZ-backed).
+
+Reference analog: ``proto/prysm/v1alpha1`` protobuf + fastssz
+generated types [U, SURVEY.md §2 "proto"].  Instead of generated
+marshal code, containers declare their SSZ schema directly; the codec
+derives wire format and hash tree roots.
+
+Config-independent containers live at module level; containers whose
+shapes depend on the chain preset (BeaconState, HistoricalBatch, block
+body list limits) are built per-config by ``build_types`` and cached —
+the analog of the reference's mainnet/minimal generated variants.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from ..config import BeaconChainConfig, beacon_config
+from .. import ssz
+
+Bytes4 = ssz.ByteVector(4)
+
+# phase-0 constants that are spec-level (not preset-level)
+MAX_VALIDATORS_PER_COMMITTEE = 2048
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+JUSTIFICATION_BITS_LENGTH = 4
+VALIDATOR_REGISTRY_LIMIT = 2 ** 40
+
+
+class Fork(ssz.Container):
+    fields = [
+        ("previous_version", Bytes4),
+        ("current_version", Bytes4),
+        ("epoch", ssz.uint64),
+    ]
+
+
+class ForkData(ssz.Container):
+    fields = [
+        ("current_version", Bytes4),
+        ("genesis_validators_root", ssz.Bytes32),
+    ]
+
+
+class Checkpoint(ssz.Container):
+    fields = [
+        ("epoch", ssz.uint64),
+        ("root", ssz.Bytes32),
+    ]
+
+
+class Validator(ssz.Container):
+    fields = [
+        ("pubkey", ssz.Bytes48),
+        ("withdrawal_credentials", ssz.Bytes32),
+        ("effective_balance", ssz.uint64),
+        ("slashed", ssz.boolean),
+        ("activation_eligibility_epoch", ssz.uint64),
+        ("activation_epoch", ssz.uint64),
+        ("exit_epoch", ssz.uint64),
+        ("withdrawable_epoch", ssz.uint64),
+    ]
+
+
+class AttestationData(ssz.Container):
+    fields = [
+        ("slot", ssz.uint64),
+        ("index", ssz.uint64),
+        ("beacon_block_root", ssz.Bytes32),
+        ("source", Checkpoint),
+        ("target", Checkpoint),
+    ]
+
+
+class IndexedAttestation(ssz.Container):
+    fields = [
+        ("attesting_indices",
+         ssz.List(ssz.uint64, MAX_VALIDATORS_PER_COMMITTEE)),
+        ("data", AttestationData),
+        ("signature", ssz.Bytes96),
+    ]
+
+
+class PendingAttestation(ssz.Container):
+    fields = [
+        ("aggregation_bits", ssz.Bitlist(MAX_VALIDATORS_PER_COMMITTEE)),
+        ("data", AttestationData),
+        ("inclusion_delay", ssz.uint64),
+        ("proposer_index", ssz.uint64),
+    ]
+
+
+class Attestation(ssz.Container):
+    fields = [
+        ("aggregation_bits", ssz.Bitlist(MAX_VALIDATORS_PER_COMMITTEE)),
+        ("data", AttestationData),
+        ("signature", ssz.Bytes96),
+    ]
+
+
+class AggregateAndProof(ssz.Container):
+    fields = [
+        ("aggregator_index", ssz.uint64),
+        ("aggregate", Attestation),
+        ("selection_proof", ssz.Bytes96),
+    ]
+
+
+class SignedAggregateAndProof(ssz.Container):
+    fields = [
+        ("message", AggregateAndProof),
+        ("signature", ssz.Bytes96),
+    ]
+
+
+class Eth1Data(ssz.Container):
+    fields = [
+        ("deposit_root", ssz.Bytes32),
+        ("deposit_count", ssz.uint64),
+        ("block_hash", ssz.Bytes32),
+    ]
+
+
+class DepositMessage(ssz.Container):
+    fields = [
+        ("pubkey", ssz.Bytes48),
+        ("withdrawal_credentials", ssz.Bytes32),
+        ("amount", ssz.uint64),
+    ]
+
+
+class DepositData(ssz.Container):
+    fields = [
+        ("pubkey", ssz.Bytes48),
+        ("withdrawal_credentials", ssz.Bytes32),
+        ("amount", ssz.uint64),
+        ("signature", ssz.Bytes96),
+    ]
+
+
+class Deposit(ssz.Container):
+    fields = [
+        ("proof",
+         ssz.Vector(ssz.Bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1)),
+        ("data", DepositData),
+    ]
+
+
+class BeaconBlockHeader(ssz.Container):
+    fields = [
+        ("slot", ssz.uint64),
+        ("proposer_index", ssz.uint64),
+        ("parent_root", ssz.Bytes32),
+        ("state_root", ssz.Bytes32),
+        ("body_root", ssz.Bytes32),
+    ]
+
+
+class SignedBeaconBlockHeader(ssz.Container):
+    fields = [
+        ("message", BeaconBlockHeader),
+        ("signature", ssz.Bytes96),
+    ]
+
+
+class SigningData(ssz.Container):
+    fields = [
+        ("object_root", ssz.Bytes32),
+        ("domain", ssz.Bytes32),
+    ]
+
+
+class ProposerSlashing(ssz.Container):
+    fields = [
+        ("signed_header_1", SignedBeaconBlockHeader),
+        ("signed_header_2", SignedBeaconBlockHeader),
+    ]
+
+
+class AttesterSlashing(ssz.Container):
+    fields = [
+        ("attestation_1", IndexedAttestation),
+        ("attestation_2", IndexedAttestation),
+    ]
+
+
+class VoluntaryExit(ssz.Container):
+    fields = [
+        ("epoch", ssz.uint64),
+        ("validator_index", ssz.uint64),
+    ]
+
+
+class SignedVoluntaryExit(ssz.Container):
+    fields = [
+        ("message", VoluntaryExit),
+        ("signature", ssz.Bytes96),
+    ]
+
+
+# --- config-dependent containers -------------------------------------------
+
+_TYPE_CACHE: dict[str, SimpleNamespace] = {}
+
+
+def build_types(cfg: BeaconChainConfig) -> SimpleNamespace:
+    """Containers whose list/vector shapes come from the preset."""
+    cached = _TYPE_CACHE.get(cfg.preset_name)
+    if cached is not None:
+        return cached
+
+    class BeaconBlockBody(ssz.Container):
+        fields = [
+            ("randao_reveal", ssz.Bytes96),
+            ("eth1_data", Eth1Data),
+            ("graffiti", ssz.Bytes32),
+            ("proposer_slashings",
+             ssz.List(ProposerSlashing, cfg.max_proposer_slashings)),
+            ("attester_slashings",
+             ssz.List(AttesterSlashing, cfg.max_attester_slashings)),
+            ("attestations", ssz.List(Attestation, cfg.max_attestations)),
+            ("deposits", ssz.List(Deposit, cfg.max_deposits)),
+            ("voluntary_exits",
+             ssz.List(SignedVoluntaryExit, cfg.max_voluntary_exits)),
+        ]
+
+    class BeaconBlock(ssz.Container):
+        fields = [
+            ("slot", ssz.uint64),
+            ("proposer_index", ssz.uint64),
+            ("parent_root", ssz.Bytes32),
+            ("state_root", ssz.Bytes32),
+            ("body", BeaconBlockBody),
+        ]
+
+    class SignedBeaconBlock(ssz.Container):
+        fields = [
+            ("message", BeaconBlock),
+            ("signature", ssz.Bytes96),
+        ]
+
+    class HistoricalBatch(ssz.Container):
+        fields = [
+            ("block_roots",
+             ssz.Vector(ssz.Bytes32, cfg.slots_per_historical_root)),
+            ("state_roots",
+             ssz.Vector(ssz.Bytes32, cfg.slots_per_historical_root)),
+        ]
+
+    max_pending = cfg.max_attestations * cfg.slots_per_epoch
+
+    class BeaconState(ssz.Container):
+        fields = [
+            ("genesis_time", ssz.uint64),
+            ("genesis_validators_root", ssz.Bytes32),
+            ("slot", ssz.uint64),
+            ("fork", Fork),
+            ("latest_block_header", BeaconBlockHeader),
+            ("block_roots",
+             ssz.Vector(ssz.Bytes32, cfg.slots_per_historical_root)),
+            ("state_roots",
+             ssz.Vector(ssz.Bytes32, cfg.slots_per_historical_root)),
+            ("historical_roots",
+             ssz.List(ssz.Bytes32, cfg.historical_roots_limit)),
+            ("eth1_data", Eth1Data),
+            ("eth1_data_votes",
+             ssz.List(Eth1Data, cfg.epochs_per_eth1_voting_period
+                      * cfg.slots_per_epoch)),
+            ("eth1_deposit_index", ssz.uint64),
+            ("validators",
+             ssz.List(Validator, VALIDATOR_REGISTRY_LIMIT)),
+            ("balances", ssz.List(ssz.uint64, VALIDATOR_REGISTRY_LIMIT)),
+            ("randao_mixes",
+             ssz.Vector(ssz.Bytes32, cfg.epochs_per_historical_vector)),
+            ("slashings",
+             ssz.Vector(ssz.uint64, cfg.epochs_per_slashings_vector)),
+            ("previous_epoch_attestations",
+             ssz.List(PendingAttestation, max_pending)),
+            ("current_epoch_attestations",
+             ssz.List(PendingAttestation, max_pending)),
+            ("justification_bits",
+             ssz.Bitvector(JUSTIFICATION_BITS_LENGTH)),
+            ("previous_justified_checkpoint", Checkpoint),
+            ("current_justified_checkpoint", Checkpoint),
+            ("finalized_checkpoint", Checkpoint),
+        ]
+
+    ns = SimpleNamespace(
+        BeaconBlockBody=BeaconBlockBody,
+        BeaconBlock=BeaconBlock,
+        SignedBeaconBlock=SignedBeaconBlock,
+        HistoricalBatch=HistoricalBatch,
+        BeaconState=BeaconState,
+        config=cfg,
+    )
+    _TYPE_CACHE[cfg.preset_name] = ns
+    return ns
+
+
+def active_types() -> SimpleNamespace:
+    """Types for the active preset (params.BeaconConfig() analog)."""
+    return build_types(beacon_config())

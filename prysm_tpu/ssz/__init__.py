@@ -1,0 +1,37 @@
+"""SSZ: SimpleSerialize codec + hash-tree-root.
+
+Reference analog: ``encoding/ssz/`` + fastssz-generated marshal code
+[U, SURVEY.md §2 "SSZ codec"].  The host codec here is the trusted
+golden model (hashlib Merkleization); ``merkle_jax`` provides the
+TPU-batched SHA-256 Merkleizer for the hot paths
+(``stateutil.HashTreeRoot`` analog)."""
+
+from .codec import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Container,
+    List,
+    Vector,
+    boolean,
+    deserialize,
+    hash_tree_root,
+    serialize,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+)
+
+__all__ = [
+    "Bitlist", "Bitvector", "ByteList", "ByteVector", "Bytes32",
+    "Bytes48", "Bytes96", "Container", "List", "Vector", "boolean",
+    "deserialize", "hash_tree_root", "serialize", "uint8", "uint16",
+    "uint32", "uint64", "uint128", "uint256",
+]

@@ -1,0 +1,581 @@
+"""SSZ codec: serialize / deserialize / hash_tree_root (host golden).
+
+Reference analog: ``encoding/ssz`` + fastssz generated code [U,
+SURVEY.md §2].  Implements the consensus-spec SSZ:
+
+* basic types (uintN little-endian, boolean)
+* Vector / List (fixed- and variable-size elements, 4-byte offsets)
+* ByteVector / ByteList (bytes-native fast path)
+* Bitvector / Bitlist (delimiter bit on the wire, not in the root)
+* Container (ordered named fields)
+* hash_tree_root: pack -> merkleize(pad to limit) -> mix_in_length
+
+The Merkleizer here is hashlib (trusted, slow); ``merkle_jax`` is the
+device implementation, differential-tested against this one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+from typing import Any, Sequence
+
+BYTES_PER_CHUNK = 32
+ZERO_CHUNK = b"\x00" * BYTES_PER_CHUNK
+
+# zero-subtree hash ladder: ZERO_HASHES[i] = root of an all-zero
+# depth-i subtree
+ZERO_HASHES = [ZERO_CHUNK]
+for _ in range(64):
+    ZERO_HASHES.append(
+        hashlib.sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]).digest())
+
+
+def _hash2(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+def _next_pow2(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None
+                     ) -> bytes:
+    """Merkleize chunks, virtually padded with zero chunks to
+    next_pow2(limit or len(chunks)).  Uses the zero ladder so a 2**40
+    list limit costs only depth, not memory."""
+    count = len(chunks)
+    size = _next_pow2(limit if limit is not None else max(count, 1))
+    if limit is not None and count > limit:
+        raise ValueError("chunk count exceeds limit")
+    depth = size.bit_length() - 1
+    layer = list(chunks)
+    for d in range(depth):
+        if len(layer) % 2 == 1:
+            layer.append(ZERO_HASHES[d])
+        layer = [_hash2(layer[i], layer[i + 1])
+                 for i in range(0, len(layer), 2)]
+        if not layer:
+            layer = [ZERO_HASHES[d + 1]]
+    return layer[0] if layer else ZERO_HASHES[depth]
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return _hash2(root, length.to_bytes(32, "little"))
+
+
+def _pack_bytes(data: bytes) -> list[bytes]:
+    if not data:
+        return []
+    pad = (-len(data)) % BYTES_PER_CHUNK
+    data = data + b"\x00" * pad
+    return [data[i:i + BYTES_PER_CHUNK]
+            for i in range(0, len(data), BYTES_PER_CHUNK)]
+
+
+# --- type descriptors ------------------------------------------------------
+
+
+class SSZType:
+    def is_fixed_size(self) -> bool:
+        raise NotImplementedError
+
+    def fixed_size(self) -> int:
+        raise NotImplementedError
+
+    def serialize(self, value) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes):
+        raise NotImplementedError
+
+    def hash_tree_root(self, value) -> bytes:
+        raise NotImplementedError
+
+    def default(self):
+        raise NotImplementedError
+
+
+class UInt(SSZType):
+    def __init__(self, bits: int):
+        self.bits = bits
+        self.nbytes = bits // 8
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return self.nbytes
+
+    def serialize(self, value) -> bytes:
+        return int(value).to_bytes(self.nbytes, "little")
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.nbytes:
+            raise ValueError(f"uint{self.bits}: bad length {len(data)}")
+        return int.from_bytes(data, "little")
+
+    def hash_tree_root(self, value) -> bytes:
+        return self.serialize(value).ljust(BYTES_PER_CHUNK, b"\x00")
+
+    def default(self):
+        return 0
+
+    def __repr__(self):
+        return f"uint{self.bits}"
+
+
+class Boolean(SSZType):
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return 1
+
+    def serialize(self, value) -> bytes:
+        return b"\x01" if value else b"\x00"
+
+    def deserialize(self, data: bytes):
+        if data == b"\x00":
+            return False
+        if data == b"\x01":
+            return True
+        raise ValueError("invalid boolean encoding")
+
+    def hash_tree_root(self, value) -> bytes:
+        return self.serialize(value).ljust(BYTES_PER_CHUNK, b"\x00")
+
+    def default(self):
+        return False
+
+    def __repr__(self):
+        return "boolean"
+
+
+uint8 = UInt(8)
+uint16 = UInt(16)
+uint32 = UInt(32)
+uint64 = UInt(64)
+uint128 = UInt(128)
+uint256 = UInt(256)
+boolean = Boolean()
+
+
+class ByteVector(SSZType):
+    def __init__(self, length: int):
+        self.length = length
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return self.length
+
+    def serialize(self, value: bytes) -> bytes:
+        if len(value) != self.length:
+            raise ValueError(
+                f"ByteVector[{self.length}]: got {len(value)} bytes")
+        return bytes(value)
+
+    def deserialize(self, data: bytes) -> bytes:
+        return self.serialize(data)
+
+    def hash_tree_root(self, value: bytes) -> bytes:
+        return merkleize_chunks(_pack_bytes(self.serialize(value)))
+
+    def default(self) -> bytes:
+        return b"\x00" * self.length
+
+    def __repr__(self):
+        return f"ByteVector[{self.length}]"
+
+
+Bytes32 = ByteVector(32)
+Bytes48 = ByteVector(48)
+Bytes96 = ByteVector(96)
+
+
+class ByteList(SSZType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def serialize(self, value: bytes) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("ByteList over limit")
+        return bytes(value)
+
+    def deserialize(self, data: bytes) -> bytes:
+        return self.serialize(data)
+
+    def hash_tree_root(self, value: bytes) -> bytes:
+        limit_chunks = (self.limit + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+        return mix_in_length(
+            merkleize_chunks(_pack_bytes(self.serialize(value)),
+                             limit_chunks), len(value))
+
+    def default(self) -> bytes:
+        return b""
+
+    def __repr__(self):
+        return f"ByteList[{self.limit}]"
+
+
+class Vector(SSZType):
+    def __init__(self, elem: SSZType, length: int):
+        if length <= 0:
+            raise ValueError("Vector length must be positive")
+        self.elem = elem
+        self.length = length
+
+    def is_fixed_size(self):
+        return self.elem.is_fixed_size()
+
+    def fixed_size(self):
+        return self.elem.fixed_size() * self.length
+
+    def serialize(self, value) -> bytes:
+        if len(value) != self.length:
+            raise ValueError("Vector length mismatch")
+        return _serialize_elems(self.elem, value)
+
+    def deserialize(self, data: bytes):
+        return _deserialize_elems(self.elem, data, count=self.length)
+
+    def hash_tree_root(self, value) -> bytes:
+        if len(value) != self.length:
+            raise ValueError("Vector length mismatch")
+        if isinstance(self.elem, (UInt, Boolean)):
+            packed = _pack_bytes(
+                b"".join(self.elem.serialize(v) for v in value))
+            return merkleize_chunks(packed)
+        return merkleize_chunks(
+            [self.elem.hash_tree_root(v) for v in value])
+
+    def default(self):
+        return [self.elem.default() for _ in range(self.length)]
+
+    def __repr__(self):
+        return f"Vector[{self.elem!r}, {self.length}]"
+
+
+class List(SSZType):
+    def __init__(self, elem: SSZType, limit: int):
+        self.elem = elem
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def serialize(self, value) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("List over limit")
+        return _serialize_elems(self.elem, value)
+
+    def deserialize(self, data: bytes):
+        out = _deserialize_elems(self.elem, data, count=None)
+        if len(out) > self.limit:
+            raise ValueError("List over limit")
+        return out
+
+    def hash_tree_root(self, value) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("List over limit")
+        if isinstance(self.elem, (UInt, Boolean)):
+            packed = _pack_bytes(
+                b"".join(self.elem.serialize(v) for v in value))
+            limit_chunks = (self.limit * self.elem.fixed_size()
+                            + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+            return mix_in_length(
+                merkleize_chunks(packed, limit_chunks), len(value))
+        roots = [self.elem.hash_tree_root(v) for v in value]
+        return mix_in_length(
+            merkleize_chunks(roots, self.limit), len(value))
+
+    def default(self):
+        return []
+
+    def __repr__(self):
+        return f"List[{self.elem!r}, {self.limit}]"
+
+
+class Bitvector(SSZType):
+    def __init__(self, length: int):
+        if length <= 0:
+            raise ValueError("Bitvector length must be positive")
+        self.length = length
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return (self.length + 7) // 8
+
+    def serialize(self, value: Sequence[bool]) -> bytes:
+        if len(value) != self.length:
+            raise ValueError("Bitvector length mismatch")
+        return _bits_to_bytes(value)
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.fixed_size():
+            raise ValueError("Bitvector bad byte length")
+        bits = _bytes_to_bits(data, self.length)
+        # excess bits in the last byte must be zero
+        if any(_bytes_to_bits(data, len(data) * 8)[self.length:]):
+            raise ValueError("Bitvector has set padding bits")
+        return bits
+
+    def hash_tree_root(self, value) -> bytes:
+        limit_chunks = (self.length + 255) // 256
+        return merkleize_chunks(_pack_bytes(self.serialize(value)),
+                                limit_chunks)
+
+    def default(self):
+        return [False] * self.length
+
+    def __repr__(self):
+        return f"Bitvector[{self.length}]"
+
+
+class Bitlist(SSZType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def serialize(self, value: Sequence[bool]) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("Bitlist over limit")
+        # delimiter bit marks the length
+        bits = list(value) + [True]
+        return _bits_to_bytes(bits)
+
+    def deserialize(self, data: bytes):
+        if not data:
+            raise ValueError("empty bitlist encoding")
+        if data[-1] == 0:
+            raise ValueError("bitlist missing delimiter bit")
+        nbits = (len(data) - 1) * 8 + data[-1].bit_length() - 1
+        if nbits > self.limit:
+            raise ValueError("Bitlist over limit")
+        return _bytes_to_bits(data, nbits)
+
+    def hash_tree_root(self, value) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("Bitlist over limit")
+        limit_chunks = (self.limit + 255) // 256
+        return mix_in_length(
+            merkleize_chunks(_pack_bytes(_bits_to_bytes(value)),
+                             limit_chunks), len(value))
+
+    def default(self):
+        return []
+
+    def __repr__(self):
+        return f"Bitlist[{self.limit}]"
+
+
+def _bits_to_bytes(bits: Sequence[bool]) -> bytes:
+    out = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+def _bytes_to_bits(data: bytes, nbits: int) -> list[bool]:
+    return [bool((data[i // 8] >> (i % 8)) & 1) for i in range(nbits)]
+
+
+# --- containers ------------------------------------------------------------
+
+
+class Container(SSZType):
+    """Base for consensus containers.  Subclasses declare
+    ``fields = [("name", ssz_type), ...]``; instances carry the values
+    as attributes.  The class itself doubles as its own type
+    descriptor (fields are per-class, values per-instance)."""
+
+    fields: list[tuple[str, SSZType]] = []
+
+    def __init__(self, **kwargs):
+        for name, typ in type(self).fields:
+            if name in kwargs:
+                setattr(self, name, kwargs.pop(name))
+            else:
+                setattr(self, name, typ.default())
+        if kwargs:
+            raise TypeError(f"unknown fields: {sorted(kwargs)}")
+
+    # the SSZType protocol operates on the class; `self` in the
+    # classmethod-style calls below is the *type* when used as a
+    # descriptor and the *instance* in convenience methods.
+
+    @classmethod
+    def is_fixed_size(cls):
+        return all(t.is_fixed_size() for _, t in cls.fields)
+
+    @classmethod
+    def fixed_size(cls):
+        return sum(t.fixed_size() for _, t in cls.fields)
+
+    @classmethod
+    def serialize(cls, value) -> bytes:
+        fixed_parts: list[bytes | None] = []
+        var_parts: list[bytes] = []
+        for name, typ in cls.fields:
+            v = getattr(value, name)
+            if typ.is_fixed_size():
+                fixed_parts.append(typ.serialize(v))
+            else:
+                fixed_parts.append(None)
+                var_parts.append(typ.serialize(v))
+        fixed_len = sum(len(p) if p is not None else 4
+                        for p in fixed_parts)
+        out = io.BytesIO()
+        offset = fixed_len
+        var_iter = iter(var_parts)
+        pending = list(var_parts)
+        vi = 0
+        for p in fixed_parts:
+            if p is None:
+                out.write(offset.to_bytes(4, "little"))
+                offset += len(pending[vi])
+                vi += 1
+            else:
+                out.write(p)
+        for p in pending:
+            out.write(p)
+        del var_iter
+        return out.getvalue()
+
+    @classmethod
+    def deserialize(cls, data: bytes):
+        values: dict[str, Any] = {}
+        # first pass: read fixed parts and offsets
+        pos = 0
+        offsets: list[tuple[str, SSZType, int]] = []
+        for name, typ in cls.fields:
+            if typ.is_fixed_size():
+                n = typ.fixed_size()
+                values[name] = typ.deserialize(data[pos:pos + n])
+                pos += n
+            else:
+                off = int.from_bytes(data[pos:pos + 4], "little")
+                offsets.append((name, typ, off))
+                pos += 4
+        if offsets and offsets[0][2] != pos:
+            raise ValueError("first offset does not match fixed size")
+        for i, (name, typ, off) in enumerate(offsets):
+            end = offsets[i + 1][2] if i + 1 < len(offsets) else len(data)
+            if off > end or end > len(data):
+                raise ValueError("bad offsets")
+            values[name] = typ.deserialize(data[off:end])
+        return cls(**values)
+
+    @classmethod
+    def hash_tree_root(cls, value) -> bytes:
+        roots = [typ.hash_tree_root(getattr(value, name))
+                 for name, typ in cls.fields]
+        return merkleize_chunks(roots)
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    # --- instance conveniences --------------------------------------------
+
+    def encode(self) -> bytes:
+        return type(self).serialize(self)
+
+    def root(self) -> bytes:
+        return type(self).hash_tree_root(self)
+
+    def copy(self):
+        new = type(self).__new__(type(self))
+        for name, typ in type(self).fields:
+            v = getattr(self, name)
+            if isinstance(v, list):
+                v = [x.copy() if isinstance(x, Container) else
+                     (list(x) if isinstance(x, list) else x) for x in v]
+            elif isinstance(v, Container):
+                v = v.copy()
+            setattr(new, name, v)
+        return new
+
+    def __eq__(self, o):
+        if type(self) is not type(o):
+            return NotImplemented
+        return all(getattr(self, n) == getattr(o, n)
+                   for n, _ in type(self).fields)
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={getattr(self, n)!r}"
+                          for n, _ in type(self).fields[:4])
+        more = "..." if len(type(self).fields) > 4 else ""
+        return f"{type(self).__name__}({inner}{more})"
+
+
+def _serialize_elems(elem: SSZType, values) -> bytes:
+    if elem.is_fixed_size():
+        return b"".join(elem.serialize(v) for v in values)
+    parts = [elem.serialize(v) for v in values]
+    head = len(parts) * 4
+    out = io.BytesIO()
+    off = head
+    for p in parts:
+        out.write(off.to_bytes(4, "little"))
+        off += len(p)
+    for p in parts:
+        out.write(p)
+    return out.getvalue()
+
+
+def _deserialize_elems(elem: SSZType, data: bytes, count: int | None):
+    if elem.is_fixed_size():
+        n = elem.fixed_size()
+        if count is not None and len(data) != n * count:
+            raise ValueError("bad fixed-vector byte length")
+        if len(data) % n:
+            raise ValueError("byte length not a multiple of element size")
+        return [elem.deserialize(data[i:i + n])
+                for i in range(0, len(data), n)]
+    if not data:
+        if count:
+            raise ValueError("empty data for nonempty vector")
+        return []
+    first_off = int.from_bytes(data[0:4], "little")
+    if first_off % 4 or first_off > len(data):
+        raise ValueError("bad first offset")
+    n_elems = first_off // 4
+    if count is not None and n_elems != count:
+        raise ValueError("vector count mismatch")
+    offs = [int.from_bytes(data[i * 4:i * 4 + 4], "little")
+            for i in range(n_elems)]
+    offs.append(len(data))
+    out = []
+    for i in range(n_elems):
+        if offs[i] > offs[i + 1]:
+            raise ValueError("offsets not monotonic")
+        out.append(elem.deserialize(data[offs[i]:offs[i + 1]]))
+    return out
+
+
+# --- module-level conveniences ---------------------------------------------
+
+
+def serialize(typ: SSZType, value) -> bytes:
+    return typ.serialize(value)
+
+
+def deserialize(typ: SSZType, data: bytes):
+    return typ.deserialize(data)
+
+
+def hash_tree_root(typ: SSZType, value) -> bytes:
+    return typ.hash_tree_root(value)

@@ -1,0 +1,2 @@
+"""Test fixtures and fakes (reference testing/util + testing/mock
+analogs [U, SURVEY.md §4])."""

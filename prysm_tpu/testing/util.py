@@ -1,0 +1,206 @@
+"""Deterministic consensus fixtures.
+
+Reference analog: ``testing/util`` — ``DeterministicGenesisState(t, n)``
+and ``GenerateFullBlock`` [U, SURVEY.md §4]: every fixture runs real
+BLS with deterministic keys, so crypto paths are exercised end-to-end.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..config import beacon_config
+from ..core.helpers import (
+    FAR_FUTURE_EPOCH, compute_epoch_at_slot, compute_signing_root,
+    get_beacon_committee, get_beacon_proposer_index,
+    get_committee_count_per_slot, get_current_epoch, get_domain,
+)
+from ..core.transition import _Uint64Box, process_slots, state_transition
+from ..crypto.bls import bls
+from ..proto import (
+    Attestation, AttestationData, BeaconBlockHeader, Checkpoint, Eth1Data,
+    Fork, Validator, active_types,
+)
+
+GENESIS_ETH1_BLOCK_HASH = b"\x42" * 32
+
+
+def secret_key_for(index: int) -> bls.SecretKey:
+    from ..crypto.bls.pure.signature import deterministic_secret_key
+
+    return bls.SecretKey(deterministic_secret_key(index))
+
+
+def deterministic_genesis_state(n_validators: int, types=None):
+    """A valid genesis BeaconState with n active validators holding
+    real (deterministic) BLS keys."""
+    types = types or active_types()
+    cfg = beacon_config()
+    validators, balances = [], []
+    for i in range(n_validators):
+        pk = secret_key_for(i).public_key().to_bytes()
+        wc = b"\x00" + hashlib.sha256(pk).digest()[1:]
+        validators.append(Validator(
+            pubkey=pk,
+            withdrawal_credentials=wc,
+            effective_balance=cfg.max_effective_balance,
+            slashed=False,
+            activation_eligibility_epoch=0,
+            activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        ))
+        balances.append(cfg.max_effective_balance)
+
+    from .. import ssz
+    from ..proto import VALIDATOR_REGISTRY_LIMIT
+
+    registry_type = ssz.List(Validator, VALIDATOR_REGISTRY_LIMIT)
+    genesis_validators_root = registry_type.hash_tree_root(validators)
+
+    empty_body = types.BeaconBlockBody()
+    state = types.BeaconState(
+        genesis_time=cfg.min_genesis_time,
+        genesis_validators_root=genesis_validators_root,
+        slot=0,
+        fork=Fork(previous_version=cfg.genesis_fork_version,
+                  current_version=cfg.genesis_fork_version,
+                  epoch=0),
+        latest_block_header=BeaconBlockHeader(
+            body_root=types.BeaconBlockBody.hash_tree_root(empty_body)),
+        eth1_data=Eth1Data(deposit_root=b"\x00" * 32,
+                           deposit_count=n_validators,
+                           block_hash=GENESIS_ETH1_BLOCK_HASH),
+        eth1_deposit_index=n_validators,
+        validators=validators,
+        balances=balances,
+        randao_mixes=[GENESIS_ETH1_BLOCK_HASH]
+        * cfg.epochs_per_historical_vector,
+    )
+    return state
+
+
+def sign_attestation_for_committee(state, data: AttestationData,
+                                   committee: list[int]) -> bytes:
+    cfg = beacon_config()
+    domain = get_domain(state, cfg.domain_beacon_attester,
+                        data.target.epoch)
+    root = compute_signing_root(data, domain)
+    sigs = [secret_key_for(i).sign(root) for i in committee]
+    return bls.Signature.aggregate(sigs).to_bytes()
+
+
+def valid_attestation(state, slot: int, index: int,
+                      bits: list[bool] | None = None) -> Attestation:
+    """A fully-signed attestation for (slot, committee index)."""
+    cfg = beacon_config()
+    committee = get_beacon_committee(state, slot, index)
+    if bits is None:
+        bits = [True] * len(committee)
+    epoch = compute_epoch_at_slot(slot)
+    if epoch == get_current_epoch(state):
+        source = state.current_justified_checkpoint
+    else:
+        source = state.previous_justified_checkpoint
+    epoch_start = epoch * cfg.slots_per_epoch
+    if epoch_start < state.slot:
+        from ..core.helpers import get_block_root_at_slot
+
+        target_root = get_block_root_at_slot(state, epoch_start)
+        head_root = get_block_root_at_slot(state, slot) \
+            if slot < state.slot else state.latest_block_header.root()
+    else:
+        target_root = state.latest_block_header.root()
+        head_root = target_root
+    data = AttestationData(
+        slot=slot, index=index,
+        beacon_block_root=head_root,
+        source=Checkpoint(epoch=source.epoch, root=source.root),
+        target=Checkpoint(epoch=epoch, root=target_root),
+    )
+    signers = [v for v, b in zip(get_beacon_committee(state, slot, index),
+                                 bits) if b]
+    sig = sign_attestation_for_committee(state, data, signers)
+    return Attestation(aggregation_bits=bits, data=data, signature=sig)
+
+
+def attestations_for_slot(state, att_slot: int) -> list[Attestation]:
+    """One full attestation per committee of ``att_slot``."""
+    epoch = compute_epoch_at_slot(att_slot)
+    count = get_committee_count_per_slot(state, epoch)
+    return [valid_attestation(state, att_slot, i) for i in range(count)]
+
+
+def generate_full_block(state, slot: int | None = None,
+                        attestations: list[Attestation] | None = None,
+                        types=None):
+    """GenerateFullBlock analog: a valid SignedBeaconBlock at ``slot``
+    (default: next slot) with real randao + attestation signatures.
+
+    ``state`` is not mutated."""
+    types = types or active_types()
+    cfg = beacon_config()
+    if slot is None:
+        slot = state.slot + 1
+
+    work = state.copy()
+    process_slots(work, slot, types)
+
+    if attestations is None:
+        att_slot = slot - cfg.min_attestation_inclusion_delay
+        if att_slot >= 0 and slot > 0:
+            attestations = attestations_for_slot(work, att_slot)
+        else:
+            attestations = []
+
+    proposer_index = get_beacon_proposer_index(work)
+    proposer_sk = secret_key_for(proposer_index)
+
+    epoch = get_current_epoch(work)
+    randao_domain = get_domain(work, cfg.domain_randao)
+    randao_reveal = proposer_sk.sign(
+        compute_signing_root(_Uint64Box(epoch), randao_domain)).to_bytes()
+
+    body = types.BeaconBlockBody(
+        randao_reveal=randao_reveal,
+        eth1_data=Eth1Data(
+            deposit_root=work.eth1_data.deposit_root,
+            deposit_count=work.eth1_data.deposit_count,
+            block_hash=work.eth1_data.block_hash),
+        attestations=attestations,
+    )
+    block = types.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=work.latest_block_header.root()
+        if work.latest_block_header.state_root != b"\x00" * 32
+        else _header_root_with_state(work),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+
+    # compute the post-state root on a scratch copy (no sig checks)
+    scratch = state.copy()
+    unsigned = types.SignedBeaconBlock(message=block,
+                                       signature=b"\x00" * 96)
+    state_transition(scratch, unsigned, types,
+                     validate_result=False, verify_signatures=False)
+    block.state_root = types.BeaconState.hash_tree_root(scratch)
+
+    domain = get_domain(work, cfg.domain_beacon_proposer)
+    sig = proposer_sk.sign(
+        compute_signing_root(block, domain)).to_bytes()
+    return types.SignedBeaconBlock(message=block, signature=sig)
+
+
+def _header_root_with_state(state) -> bytes:
+    header = BeaconBlockHeader(
+        slot=state.latest_block_header.slot,
+        proposer_index=state.latest_block_header.proposer_index,
+        parent_root=state.latest_block_header.parent_root,
+        state_root=state.latest_block_header.state_root,
+        body_root=state.latest_block_header.body_root,
+    )
+    if header.state_root == b"\x00" * 32:
+        header.state_root = type(state).hash_tree_root(state)
+    return header.root()

@@ -23,11 +23,16 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # Persistent compilation cache: the limb-arithmetic graphs are big and
 # recompiling them per pytest run would dominate suite time.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      "/tmp/prysm_tpu_jax_cache")
+                      "/root/repo/.jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 import jax  # noqa: E402  (after env setup, before any test imports)
 
 jax.config.update("jax_platforms", "cpu")
+# this jax build ignores the JAX_COMPILATION_CACHE_DIR env var — the
+# config key must be set explicitly or nothing is ever cached
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 assert jax.devices()[0].platform == "cpu"
 assert len(jax.devices()) == 8, jax.devices()

@@ -1,0 +1,229 @@
+"""SSZ codec + device Merkleizer tests.
+
+Golden checks use hand-derivable known answers (zero ladders, packed
+uints) and structural round-trips; the device Merkleizer is
+differential-tested byte-for-byte against the hashlib codec."""
+
+import hashlib
+import random
+from dataclasses import dataclass
+
+import pytest
+
+from prysm_tpu import ssz
+from prysm_tpu.ssz import codec as C
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(0x55A)
+
+
+class TestBasic:
+    def test_uint_roundtrip(self):
+        assert ssz.uint64.serialize(0xDEAD) == (0xDEAD).to_bytes(8, "little")
+        assert ssz.uint64.deserialize(b"\x01" + b"\x00" * 7) == 1
+        assert ssz.uint256.deserialize(ssz.uint256.serialize(7**30)) == 7**30
+
+    def test_uint_root_is_padded_le(self):
+        assert ssz.hash_tree_root(ssz.uint64, 5) == (
+            (5).to_bytes(8, "little") + b"\x00" * 24)
+
+    def test_boolean(self):
+        assert ssz.boolean.serialize(True) == b"\x01"
+        with pytest.raises(ValueError):
+            ssz.boolean.deserialize(b"\x02")
+
+    def test_bytes32(self):
+        v = bytes(range(32))
+        assert ssz.Bytes32.hash_tree_root(v) == v  # single chunk
+
+    def test_bytes48_root(self):
+        v = bytes(range(48))
+        want = hashlib.sha256(v[:32] + v[32:].ljust(32, b"\x00")).digest()
+        assert ssz.Bytes48.hash_tree_root(v) == want
+
+
+class TestVectorsLists:
+    def test_uint_vector_pack(self):
+        typ = ssz.Vector(ssz.uint64, 4)
+        vals = [1, 2, 3, 4]
+        chunk = b"".join(v.to_bytes(8, "little") for v in vals)
+        assert typ.hash_tree_root(vals) == chunk  # one chunk exactly
+        assert typ.deserialize(typ.serialize(vals)) == vals
+
+    def test_list_mixes_length(self):
+        typ = ssz.List(ssz.uint64, 4)
+        root_empty = typ.hash_tree_root([])
+        want = hashlib.sha256(
+            C.ZERO_CHUNK + (0).to_bytes(32, "little")).digest()
+        assert root_empty == want
+
+    def test_list_limit_enforced(self):
+        typ = ssz.List(ssz.uint8, 2)
+        with pytest.raises(ValueError):
+            typ.serialize([1, 2, 3])
+        with pytest.raises(ValueError):
+            typ.hash_tree_root([1, 2, 3])
+
+    def test_variable_elem_list_roundtrip(self):
+        typ = ssz.List(ssz.ByteList(10), 5)
+        vals = [b"", b"ab", b"cdefg"]
+        assert typ.deserialize(typ.serialize(vals)) == vals
+
+    def test_big_limit_zero_ladder(self):
+        """2**40-limit list with 3 entries must use the ladder, not 2**40
+        memory."""
+        typ = ssz.List(ssz.Bytes32, 1 << 40)
+        root = typ.hash_tree_root([b"\x11" * 32, b"\x22" * 32, b"\x33" * 32])
+        assert len(root) == 32
+
+
+class TestBits:
+    def test_bitvector_roundtrip(self):
+        typ = ssz.Bitvector(10)
+        bits = [True, False] * 5
+        assert typ.deserialize(typ.serialize(bits)) == bits
+
+    def test_bitvector_padding_bits_rejected(self):
+        typ = ssz.Bitvector(4)
+        with pytest.raises(ValueError):
+            typ.deserialize(b"\xff")  # bits 4..7 set
+
+    def test_bitlist_roundtrip(self, rng):
+        typ = ssz.Bitlist(100)
+        for n in (0, 1, 7, 8, 9, 100):
+            bits = [bool(rng.getrandbits(1)) for _ in range(n)]
+            assert typ.deserialize(typ.serialize(bits)) == bits
+
+    def test_bitlist_delimiter_not_in_root(self):
+        """Root of [T] and wire of [T] differ: delimiter only on wire."""
+        typ = ssz.Bitlist(8)
+        assert typ.serialize([True]) == b"\x03"
+        packed = C._pack_bytes(b"\x01")
+        want = C.mix_in_length(C.merkleize_chunks(packed, 1), 1)
+        assert typ.hash_tree_root([True]) == want
+
+    def test_bitlist_missing_delimiter(self):
+        with pytest.raises(ValueError):
+            ssz.Bitlist(8).deserialize(b"\x00")
+
+
+class Pair(ssz.Container):
+    fields = [("a", ssz.uint64), ("b", ssz.Bytes32)]
+
+
+class VarHolder(ssz.Container):
+    fields = [("n", ssz.uint8), ("items", ssz.List(ssz.uint64, 8)),
+              ("tail", ssz.Bytes32)]
+
+
+class TestContainer:
+    def test_defaults(self):
+        p = Pair()
+        assert p.a == 0 and p.b == b"\x00" * 32
+
+    def test_roundtrip(self):
+        p = Pair(a=7, b=b"\x42" * 32)
+        assert Pair.deserialize(p.encode()) == p
+
+    def test_var_roundtrip(self):
+        v = VarHolder(n=3, items=[5, 6], tail=b"\x01" * 32)
+        assert VarHolder.deserialize(v.encode()) == v
+
+    def test_root_is_field_merkle(self):
+        p = Pair(a=7, b=b"\x42" * 32)
+        want = hashlib.sha256(
+            (7).to_bytes(8, "little") + b"\x00" * 24 + b"\x42" * 32
+        ).digest()
+        assert p.root() == want
+
+    def test_copy_is_deep_enough(self):
+        v = VarHolder(items=[1])
+        w = v.copy()
+        w.items.append(2)
+        assert v.items == [1]
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(TypeError):
+            Pair(zzz=1)
+
+
+@dataclass
+class FakeValidator:
+    pubkey: bytes
+    withdrawal_credentials: bytes
+    effective_balance: int
+    slashed: bool
+    activation_eligibility_epoch: int
+    activation_epoch: int
+    exit_epoch: int
+    withdrawable_epoch: int
+
+
+def validator_ssz_type():
+    class Validator(ssz.Container):
+        fields = [
+            ("pubkey", ssz.Bytes48),
+            ("withdrawal_credentials", ssz.Bytes32),
+            ("effective_balance", ssz.uint64),
+            ("slashed", ssz.boolean),
+            ("activation_eligibility_epoch", ssz.uint64),
+            ("activation_epoch", ssz.uint64),
+            ("exit_epoch", ssz.uint64),
+            ("withdrawable_epoch", ssz.uint64),
+        ]
+    return Validator
+
+
+def rand_validator(rng, cls):
+    return cls(
+        pubkey=rng.randbytes(48),
+        withdrawal_credentials=rng.randbytes(32),
+        effective_balance=rng.randrange(32 * 10**9),
+        slashed=bool(rng.getrandbits(1)),
+        activation_eligibility_epoch=rng.randrange(2**32),
+        activation_epoch=rng.randrange(2**32),
+        exit_epoch=rng.randrange(2**32),
+        withdrawable_epoch=rng.randrange(2**32),
+    )
+
+
+class TestMerkleJax:
+    def test_hash_pairs_matches_hashlib(self, rng):
+        from prysm_tpu.ssz import merkle_jax as M
+
+        import numpy as np
+
+        msgs = [rng.randbytes(64) for _ in range(5)]
+        words = np.stack([
+            np.frombuffer(m, dtype=">u4").astype(np.uint32) for m in msgs])
+        got = M.hash_pairs(words)
+        for i, m in enumerate(msgs):
+            assert M.words_to_chunk(got[i]) == hashlib.sha256(m).digest()
+
+    def test_merkleize_matches_codec(self, rng):
+        from prysm_tpu.ssz import merkle_jax as M
+
+        import numpy as np
+
+        chunks = [rng.randbytes(32) for _ in range(5)]
+        words = np.stack([M.chunk_to_words(c) for c in chunks])
+        got = M.words_to_chunk(M.merkleize_device(words, 4))
+        assert got == C.merkleize_chunks(chunks, 16)
+
+    def test_registry_root_matches_codec(self, rng):
+        from prysm_tpu.ssz import merkle_jax as M
+
+        cls = validator_ssz_type()
+        vals = [rand_validator(rng, cls) for _ in range(7)]
+        got = M.registry_root(vals)
+        typ = ssz.List(cls, 1 << 40)
+        assert got == typ.hash_tree_root(vals)
+
+    def test_registry_root_empty(self):
+        from prysm_tpu.ssz import merkle_jax as M
+
+        cls = validator_ssz_type()
+        typ = ssz.List(cls, 1 << 40)
+        assert M.registry_root([]) == typ.hash_tree_root([])

@@ -1,0 +1,83 @@
+"""Differential tests: device hash-to-G2 vs the pure golden model."""
+
+import random
+
+import numpy as np
+import pytest
+
+from prysm_tpu.crypto.bls.params import ETH2_DST, P
+from prysm_tpu.crypto.bls.pure import hash_to_curve as ph
+from prysm_tpu.crypto.bls.pure.fields import Fq2
+from prysm_tpu.crypto.bls.xla import h2c as xh
+from prysm_tpu.crypto.bls.xla import tower as T
+from prysm_tpu.crypto.bls.xla.curve import unpack_g2_points
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(0x42C2)
+
+
+def rand_fq2(rng):
+    return Fq2.from_ints(rng.randrange(P), rng.randrange(P))
+
+
+class TestSqrtSquare:
+    def test_is_square(self, rng):
+        squares = [rand_fq2(rng) for _ in range(3)]
+        squares = [s * s for s in squares]
+        non = []
+        while len(non) < 3:
+            c = rand_fq2(rng)
+            if c.sqrt() is None:
+                non.append(c)
+        vals = squares + non
+        got = np.asarray(xh.fq2_is_square(T.pack_fq2(vals)))
+        assert got.tolist() == [True] * 3 + [False] * 3
+
+    def test_sqrt_matches_pure(self, rng):
+        vals = [rand_fq2(rng) for _ in range(4)]
+        vals = [v * v for v in vals]
+        got = T.unpack_fq2(xh.fq2_sqrt(T.pack_fq2(vals)))
+        for g, v in zip(got, vals):
+            assert g == v.sqrt()  # pure returns the same principal root
+
+    def test_sgn0(self, rng):
+        vals = [Fq2.from_ints(0, 1), Fq2.from_ints(2, 1),
+                Fq2.from_ints(3, 0)] + [rand_fq2(rng) for _ in range(3)]
+        got = np.asarray(xh.fq2_sgn0(T.pack_fq2(vals)))
+        assert got.tolist() == [v.sgn0() for v in vals]
+
+
+class TestSswu:
+    def test_map_to_curve_matches_pure(self, rng):
+        us = [rand_fq2(rng) for _ in range(4)]
+        x, y = xh.map_to_curve_sswu(T.pack_fq2(us))
+        got = list(zip(T.unpack_fq2(x), T.unpack_fq2(y)))
+        want = [ph.map_to_curve_sswu(u) for u in us]
+        assert got == want
+
+    def test_iso_map_matches_pure(self, rng):
+        us = [rand_fq2(rng) for _ in range(2)]
+        pts = [ph.map_to_curve_sswu(u) for u in us]
+        x = T.pack_fq2([p[0] for p in pts])
+        y = T.pack_fq2([p[1] for p in pts])
+        xo, yo = xh.iso_map_to_e2(x, y)
+        got = list(zip(T.unpack_fq2(xo), T.unpack_fq2(yo)))
+        want = [ph.iso_map_to_e2(p) for p in pts]
+        assert got == want
+
+
+class TestHashToG2:
+    def test_matches_pure(self, rng):
+        msgs = [b"", b"abc", rng.randbytes(57)]
+        out = xh.hash_to_g2(msgs, ETH2_DST)
+        got = unpack_g2_points(out)
+        want = [ph.hash_to_g2(m, ETH2_DST) for m in msgs]
+        assert got == want
+
+    def test_other_dst(self, rng):
+        dst = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+        msgs = [b"abcdef0123456789"]
+        got = unpack_g2_points(xh.hash_to_g2(msgs, dst))
+        assert got == [ph.hash_to_g2(msgs[0], dst)]
