@@ -1,0 +1,14 @@
+"""LRU caches for hot consensus lookups.
+
+Reference analog: ``beacon-chain/cache/`` (committee cache, hot-state
+cache, checkpoint-state cache) [U, SURVEY.md §2 "cache"].
+"""
+
+from .lru import LRUCache
+from .committee import CommitteeCache, committee_cache
+from .state import CheckpointStateCache, HotStateCache
+
+__all__ = [
+    "LRUCache", "CommitteeCache", "committee_cache",
+    "CheckpointStateCache", "HotStateCache",
+]

@@ -1,0 +1,63 @@
+"""Committee cache: shuffled committee assignments per (seed, epoch).
+
+Reference analog: ``beacon-chain/cache/committee.go``
+(CommitteeCache.Committee, keyed by seed) [U, SURVEY.md §2 "core/helpers"
+committee cache].  One entry holds the epoch's full shuffled validator
+list; committee slices are computed views, so a whole epoch of
+``get_beacon_committee`` calls costs one shuffle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .lru import LRUCache
+
+
+@dataclass
+class Committees:
+    """All committees of one epoch, derived from one shuffle."""
+
+    seed: bytes
+    shuffled_indices: tuple[int, ...]   # active indices in shuffled order
+    committees_per_slot: int
+    slots_per_epoch: int
+
+    def committee(self, slot: int, index: int) -> list[int]:
+        count = self.committees_per_slot * self.slots_per_epoch
+        which = (slot % self.slots_per_epoch) * self.committees_per_slot \
+            + index
+        n = len(self.shuffled_indices)
+        start = n * which // count
+        end = n * (which + 1) // count
+        return list(self.shuffled_indices[start:end])
+
+
+class CommitteeCache:
+    def __init__(self, maxsize: int = 32):
+        self._cache = LRUCache(maxsize, name="committee")
+
+    def get(self, seed: bytes) -> Committees | None:
+        return self._cache.get(seed)
+
+    def put(self, entry: Committees) -> None:
+        self._cache.put(entry.seed, entry)
+
+    def get_or_compute(self, key: bytes, build) -> Committees:
+        """Single copy of the get/compute/put pattern (LRUCache
+        semantics: compute outside the lock, last writer wins)."""
+        return self._cache.get_or_compute(key, build)
+
+    @property
+    def hits(self) -> int:
+        return self._cache.hits
+
+    @property
+    def misses(self) -> int:
+        return self._cache.misses
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+
+committee_cache = CommitteeCache()

@@ -1,0 +1,65 @@
+"""Thread-safe LRU cache primitive.
+
+Reference analog: the hashicorp/golang-lru instances used throughout
+``beacon-chain/cache/`` [U, SURVEY.md §2 "cache"].  Metrics hooks
+(hit/miss counters) match the reference's prometheus instrumentation.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Hashable
+
+
+class LRUCache:
+    def __init__(self, maxsize: int = 128, name: str = ""):
+        if maxsize <= 0:
+            raise ValueError("maxsize must be positive")
+        self.maxsize = maxsize
+        self.name = name
+        self._data: OrderedDict[Hashable, Any] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: Hashable, default: Any = None) -> Any:
+        with self._lock:
+            try:
+                self._data.move_to_end(key)
+                self.hits += 1
+                return self._data[key]
+            except KeyError:
+                self.misses += 1
+                return default
+
+    def put(self, key: Hashable, value: Any) -> None:
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self.maxsize:
+                self._data.popitem(last=False)
+
+    def get_or_compute(self, key: Hashable,
+                       compute: Callable[[], Any]) -> Any:
+        """Single-flight-ish helper: compute outside the lock (races
+        recompute rather than deadlock; last writer wins)."""
+        sentinel = object()
+        got = self.get(key, sentinel)
+        if got is not sentinel:
+            return got
+        value = compute()
+        self.put(key, value)
+        return value
+
+    def __contains__(self, key: Hashable) -> bool:
+        with self._lock:
+            return key in self._data
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()

@@ -1,0 +1,50 @@
+"""State caches: hot states by root, checkpoint states by checkpoint.
+
+Reference analog: ``beacon-chain/cache/hot_state_cache.go`` and
+``checkpoint_state.go`` [U, SURVEY.md §2 "cache"].  Values are full
+BeaconState containers; callers must ``copy()`` before mutating.
+"""
+
+from __future__ import annotations
+
+from .lru import LRUCache
+
+
+class HotStateCache:
+    """root -> BeaconState for recently-processed blocks."""
+
+    def __init__(self, maxsize: int = 32):
+        self._cache = LRUCache(maxsize, name="hot_state")
+
+    def get(self, block_root: bytes):
+        return self._cache.get(block_root)
+
+    def put(self, block_root: bytes, state) -> None:
+        self._cache.put(block_root, state)
+
+    def has(self, block_root: bytes) -> bool:
+        return block_root in self._cache
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+
+class CheckpointStateCache:
+    """(epoch, root) checkpoint -> advanced BeaconState, used by
+    attestation verification to get the right shuffling."""
+
+    def __init__(self, maxsize: int = 16):
+        self._cache = LRUCache(maxsize, name="checkpoint_state")
+
+    @staticmethod
+    def _key(checkpoint) -> tuple[int, bytes]:
+        return (int(checkpoint.epoch), bytes(checkpoint.root))
+
+    def get(self, checkpoint):
+        return self._cache.get(self._key(checkpoint))
+
+    def put(self, checkpoint, state) -> None:
+        self._cache.put(self._key(checkpoint), state)
+
+    def clear(self) -> None:
+        self._cache.clear()

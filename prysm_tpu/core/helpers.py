@@ -9,6 +9,8 @@ from __future__ import annotations
 import hashlib
 from functools import lru_cache
 
+from ..cache import committee_cache
+from ..cache.committee import Committees
 from ..config import BeaconChainConfig, beacon_config
 from ..proto import (
     AttestationData, ForkData, IndexedAttestation, SigningData,
@@ -213,17 +215,6 @@ def shuffled_index_map(seed: bytes, count: int, cfg=None
     return _shuffled_map_cached(seed, count, cfg.shuffle_round_count)
 
 
-def compute_committee(indices: list[int], seed: bytes, index: int,
-                      count: int, cfg=None) -> list[int]:
-    """Committee `index` of `count` over shuffled `indices`."""
-    cfg = cfg or beacon_config()
-    n = len(indices)
-    start = n * index // count
-    end = n * (index + 1) // count
-    smap = shuffled_index_map(seed, n, cfg)
-    return [indices[smap[i]] for i in range(start, end)]
-
-
 def get_committee_count_per_slot(state, epoch: int, cfg=None) -> int:
     cfg = cfg or beacon_config()
     active = len(get_active_validator_indices(state, epoch))
@@ -234,15 +225,38 @@ def get_committee_count_per_slot(state, epoch: int, cfg=None) -> int:
 
 def get_beacon_committee(state, slot: int, index: int, cfg=None
                          ) -> list[int]:
+    """Committee lookup through the epoch-level committee cache
+    (reference CommitteeCache.Committee keyed by seed [U, SURVEY.md §2
+    "cache"]): one shuffle serves the whole epoch's committees.
+
+    The key matches the reference's semantics (seed identifies the
+    epoch's shuffling on a chain — the seed commits to the chain's
+    randao history) plus the registry length, which disambiguates
+    same-seed states from unrelated chains (synthetic genesis fixtures
+    of different sizes share the genesis mixes).  As in the reference,
+    two forks that share a seed AND registry length but diverge in
+    activations within the seed-lookahead window would collide; that
+    window is accepted there and here."""
     cfg = cfg or beacon_config()
     epoch = compute_epoch_at_slot(slot, cfg)
-    committees_per_slot = get_committee_count_per_slot(state, epoch, cfg)
-    indices = get_active_validator_indices(state, epoch)
     seed = get_seed(state, epoch, cfg.domain_beacon_attester, cfg)
-    return compute_committee(
-        indices, seed,
-        (slot % cfg.slots_per_epoch) * committees_per_slot + index,
-        committees_per_slot * cfg.slots_per_epoch, cfg)
+    # key carries the preset too: the seed is config-independent, and
+    # entries built under minimal must not serve mainnet queries
+    key = (seed + len(state.validators).to_bytes(8, "little")
+           + cfg.preset_name.encode())
+
+    def build() -> Committees:
+        indices = get_active_validator_indices(state, epoch)
+        smap = shuffled_index_map(seed, len(indices), cfg)
+        return Committees(
+            seed=key,
+            shuffled_indices=tuple(indices[s] for s in smap),
+            committees_per_slot=get_committee_count_per_slot(
+                state, epoch, cfg),
+            slots_per_epoch=cfg.slots_per_epoch)
+
+    return committee_cache.get_or_compute(key, build).committee(slot,
+                                                                index)
 
 
 def compute_proposer_index(state, indices: list[int], seed: bytes,

@@ -1,0 +1,11 @@
+"""Persistence layer.
+
+Reference analog: ``beacon-chain/db/kv`` — BoltDB (bbolt) buckets for
+blocks, states, checkpoints, with batch writes [U, SURVEY.md §2
+"db/kv"].
+"""
+
+from .kv import KVStore, Bucket
+from .beacon import BeaconDB, setup_db
+
+__all__ = ["KVStore", "Bucket", "BeaconDB", "setup_db"]

@@ -1,0 +1,167 @@
+"""Beacon-chain database: blocks, states, checkpoints.
+
+Reference analog: ``beacon-chain/db/kv/Store`` (SaveBlock, SaveState,
+HighestSlotBlocks, justified/finalized checkpoint buckets, state
+summaries) [U, SURVEY.md §2 "db/kv"].  Values are SSZ bytes — the
+same wire format the codec round-trips — so the DB doubles as a
+serialization conformance check.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from ..proto import Checkpoint, active_types
+from .kv import KVStore, slot_key
+
+_BLOCKS = "blocks"
+_BLOCK_SLOT_INDEX = "block_slot_index"
+_STATES = "states"
+_STATE_SUMMARIES = "state_summaries"
+_CHECKPOINTS = "checkpoints"
+_META = "meta"
+
+_JUSTIFIED = b"justified-checkpoint"
+_FINALIZED = b"finalized-checkpoint"
+_HEAD_ROOT = b"head-root"
+_GENESIS_STATE = b"genesis-state"
+
+
+class BeaconDB:
+    """Persistent store for consensus objects (SSZ-encoded)."""
+
+    def __init__(self, path: str = ":memory:", types=None):
+        self.store = KVStore(path)
+        self.types = types or active_types()
+        self._blocks = self.store.bucket(_BLOCKS)
+        self._block_slots = self.store.bucket(_BLOCK_SLOT_INDEX)
+        self._states = self.store.bucket(_STATES)
+        self._summaries = self.store.bucket(_STATE_SUMMARIES)
+        self._checkpoints = self.store.bucket(_CHECKPOINTS)
+        self._meta = self.store.bucket(_META)
+
+    # --- blocks ------------------------------------------------------------
+
+    def save_block(self, signed_block) -> bytes:
+        return self.save_blocks([signed_block])[0]
+
+    def save_blocks(self, signed_blocks) -> list[bytes]:
+        """Block + slot index commit in ONE transaction (the reference
+        writes both buckets inside a single Bolt Update)."""
+        sbt = self.types.SignedBeaconBlock
+        writes, roots = [], []
+        for sb in signed_blocks:
+            root = type(sb.message).hash_tree_root(sb.message)
+            writes.append((self._blocks, root, sbt.serialize(sb)))
+            writes.append((self._block_slots,
+                           slot_key(sb.message.slot, root), root))
+            roots.append(root)
+        self.store.put_multi(writes)
+        return roots
+
+    def block(self, root: bytes):
+        data = self._blocks.get(root)
+        if data is None:
+            return None
+        return self.types.SignedBeaconBlock.deserialize(data)
+
+    def has_block(self, root: bytes) -> bool:
+        return self._blocks.has(root)
+
+    def blocks_by_range(self, start_slot: int, end_slot: int):
+        """All blocks with start_slot <= slot < end_slot, slot order
+        (BeaconBlocksByRange req/resp backing query)."""
+        out = []
+        for _, root in self._block_slots.scan(slot_key(start_slot),
+                                              slot_key(end_slot)):
+            blk = self.block(bytes(root))
+            if blk is not None:
+                out.append(blk)
+        return out
+
+    def highest_slot_block(self):
+        """HighestSlotBlocks analog."""
+        last = self._block_slots.last()
+        if last is None:
+            return None
+        return self.block(last[1])
+
+    # --- states ------------------------------------------------------------
+
+    def save_state(self, state, block_root: bytes) -> None:
+        st = self.types.BeaconState
+        self.store.put_multi([
+            (self._states, block_root, st.serialize(state)),
+            (self._summaries, block_root,
+             int(state.slot).to_bytes(8, "big")),
+        ])
+
+    def save_state_summary(self, block_root: bytes, slot: int) -> None:
+        """Slot summary without the full state (stategen's
+        non-snapshot hot path)."""
+        self._summaries.put(block_root, int(slot).to_bytes(8, "big"))
+
+    def state(self, block_root: bytes):
+        data = self._states.get(block_root)
+        if data is None:
+            return None
+        return self.types.BeaconState.deserialize(data)
+
+    def has_state(self, block_root: bytes) -> bool:
+        return self._states.has(block_root)
+
+    def delete_state(self, block_root: bytes) -> None:
+        self._states.delete(block_root)
+
+    def persisted_state_roots(self) -> list[bytes]:
+        """Roots with a full persisted state (summaries excluded)."""
+        return self._states.keys()
+
+    def state_summary_slot(self, block_root: bytes) -> int | None:
+        data = self._summaries.get(block_root)
+        return int.from_bytes(data, "big") if data else None
+
+    def save_genesis_state(self, state) -> None:
+        self._meta.put(_GENESIS_STATE,
+                       self.types.BeaconState.serialize(state))
+
+    def genesis_state(self):
+        data = self._meta.get(_GENESIS_STATE)
+        if data is None:
+            return None
+        return self.types.BeaconState.deserialize(data)
+
+    # --- checkpoints / head ------------------------------------------------
+
+    def save_justified_checkpoint(self, cp) -> None:
+        self._checkpoints.put(_JUSTIFIED, Checkpoint.serialize(cp))
+
+    def justified_checkpoint(self):
+        data = self._checkpoints.get(_JUSTIFIED)
+        return Checkpoint.deserialize(data) if data else None
+
+    def save_finalized_checkpoint(self, cp) -> None:
+        self._checkpoints.put(_FINALIZED, Checkpoint.serialize(cp))
+
+    def finalized_checkpoint(self):
+        data = self._checkpoints.get(_FINALIZED)
+        return Checkpoint.deserialize(data) if data else None
+
+    def save_head_root(self, root: bytes) -> None:
+        self._meta.put(_HEAD_ROOT, root)
+
+    def head_root(self) -> bytes | None:
+        return self._meta.get(_HEAD_ROOT)
+
+    def close(self) -> None:
+        self.store.close()
+
+
+def setup_db(tmpdir: str | None = None, types=None) -> BeaconDB:
+    """Testing helper (reference db/testing.SetupDB analog): a fresh
+    file-backed DB in a temp dir (or in-memory when tmpdir is None)."""
+    if tmpdir is None:
+        return BeaconDB(":memory:", types=types)
+    path = os.path.join(tmpdir, "beacon.db")
+    return BeaconDB(path, types=types)

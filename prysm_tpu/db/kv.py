@@ -1,0 +1,144 @@
+"""Bucketed key-value store over SQLite.
+
+Reference analog: BoltDB (bbolt) as used by ``beacon-chain/db/kv``
+[U, SURVEY.md §2 "db/kv"]: a single-file, transactional store with
+named buckets, ordered byte-string keys, and batch writes.  SQLite
+gives the same durability/atomicity contract from the standard
+library; each bucket is one table with a BLOB primary key, so range
+scans over big-endian-encoded slots match Bolt's ordered cursors.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Iterable, Iterator
+
+
+def _quote_ident(name: str) -> str:
+    if not name.replace("_", "").isalnum():
+        raise ValueError(f"invalid bucket name {name!r}")
+    return f'"bucket_{name}"'
+
+
+class Bucket:
+    """One named keyspace (Bolt bucket analog)."""
+
+    def __init__(self, store: "KVStore", name: str):
+        self._store = store
+        self._table = _quote_ident(name)
+        self.name = name
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._store._lock:
+            row = self._store._conn.execute(
+                f"SELECT v FROM {self._table} WHERE k = ?", (key,)
+            ).fetchone()
+        return row[0] if row else None
+
+    def put(self, key: bytes, value: bytes) -> None:
+        with self._store._lock:
+            with self._store._conn:
+                self._store._conn.execute(
+                    f"INSERT OR REPLACE INTO {self._table} (k, v) "
+                    "VALUES (?, ?)", (key, value))
+
+    def put_batch(self, items: Iterable[tuple[bytes, bytes]]) -> None:
+        """Atomic multi-put (Bolt Batch/Update analog)."""
+        with self._store._lock:
+            with self._store._conn:
+                self._store._conn.executemany(
+                    f"INSERT OR REPLACE INTO {self._table} (k, v) "
+                    "VALUES (?, ?)", list(items))
+
+    def delete(self, key: bytes) -> None:
+        with self._store._lock:
+            with self._store._conn:
+                self._store._conn.execute(
+                    f"DELETE FROM {self._table} WHERE k = ?", (key,))
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def scan(self, start: bytes = b"", end: bytes | None = None
+             ) -> Iterator[tuple[bytes, bytes]]:
+        """Ordered range scan [start, end) — Bolt cursor analog."""
+        q = f"SELECT k, v FROM {self._table} WHERE k >= ?"
+        params: list = [start]
+        if end is not None:
+            q += " AND k < ?"
+            params.append(end)
+        q += " ORDER BY k"
+        with self._store._lock:
+            rows = self._store._conn.execute(q, params).fetchall()
+        yield from ((bytes(k), bytes(v)) for k, v in rows)
+
+    def keys(self) -> list[bytes]:
+        with self._store._lock:
+            rows = self._store._conn.execute(
+                f"SELECT k FROM {self._table} ORDER BY k").fetchall()
+        return [bytes(r[0]) for r in rows]
+
+    def last(self) -> tuple[bytes, bytes] | None:
+        """Largest key (Bolt Cursor.Last analog)."""
+        with self._store._lock:
+            row = self._store._conn.execute(
+                f"SELECT k, v FROM {self._table} "
+                "ORDER BY k DESC LIMIT 1").fetchone()
+        return (bytes(row[0]), bytes(row[1])) if row else None
+
+    def count(self) -> int:
+        with self._store._lock:
+            return self._store._conn.execute(
+                f"SELECT COUNT(*) FROM {self._table}").fetchone()[0]
+
+
+class KVStore:
+    """A file-backed (or in-memory) bucketed KV store."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._lock = threading.RLock()
+        self._buckets: dict[str, Bucket] = {}
+
+    def put_multi(self, writes: Iterable[tuple["Bucket", bytes, bytes]]
+                  ) -> None:
+        """Cross-bucket atomic write (Bolt Update-transaction analog):
+        all puts commit together or not at all."""
+        with self._lock:
+            with self._conn:
+                for bucket, k, v in writes:
+                    self._conn.execute(
+                        f"INSERT OR REPLACE INTO {bucket._table} (k, v) "
+                        "VALUES (?, ?)", (k, v))
+
+    def bucket(self, name: str) -> Bucket:
+        b = self._buckets.get(name)
+        if b is None:
+            table = _quote_ident(name)
+            with self._lock:
+                with self._conn:
+                    self._conn.execute(
+                        f"CREATE TABLE IF NOT EXISTS {table} "
+                        "(k BLOB PRIMARY KEY, v BLOB NOT NULL)")
+            b = Bucket(self, name)
+            self._buckets[name] = b
+        return b
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    def __enter__(self) -> "KVStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def slot_key(slot: int, root: bytes = b"") -> bytes:
+    """Big-endian slot prefix so range scans iterate in slot order."""
+    return int(slot).to_bytes(8, "big") + root

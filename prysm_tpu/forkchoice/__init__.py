@@ -1,0 +1,9 @@
+"""Fork choice: LMD-GHOST head selection.
+
+Reference analog: ``beacon-chain/forkchoice/`` (protoarray /
+doubly-linked-tree) [U, SURVEY.md §2 "fork choice"].
+"""
+
+from .store import ForkChoiceStore, Node
+
+__all__ = ["ForkChoiceStore", "Node"]

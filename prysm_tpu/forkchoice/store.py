@@ -1,0 +1,309 @@
+"""Protoarray-style LMD-GHOST fork choice store.
+
+Reference analog: ``beacon-chain/forkchoice/protoarray`` (later
+``doubly-linked-tree``) [U, SURVEY.md §2 "fork choice"]: a flat array
+of nodes with parent links, per-node weights maintained incrementally
+by applying vote *deltas* each time votes change, and best-child /
+best-descendant pointers so ``head()`` is a pointer walk after an
+O(n) backward pass.
+
+TPU-first note: vote-delta accumulation is a scatter-add over
+validator votes — done with numpy (fork choice data is tiny next to
+the crypto batches), keeping the structure array-shaped so a device
+offload stays trivial if validator counts ever warrant it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+NO_INDEX = -1
+
+
+@dataclass
+class Node:
+    """One block in the protoarray."""
+
+    slot: int
+    root: bytes
+    parent: int                   # index into nodes, NO_INDEX for tree root
+    justified_epoch: int
+    finalized_epoch: int
+    weight: int = 0
+    best_child: int = NO_INDEX
+    best_descendant: int = NO_INDEX
+    children: list[int] = field(default_factory=list)
+
+
+@dataclass
+class _Vote:
+    current_root: bytes = b"\x00" * 32
+    next_root: bytes = b"\x00" * 32
+    # -1 marks a fresh vote so a genesis-epoch (target_epoch=0)
+    # attestation still registers (the reference special-cases the
+    # empty vote the same way)
+    next_epoch: int = -1
+
+
+class ForkChoiceStore:
+    """LMD-GHOST over a protoarray.
+
+    ``insert_node`` adds blocks, ``process_attestation`` records votes,
+    ``head`` applies pending deltas and walks best-descendant pointers.
+    """
+
+    def __init__(self, justified_epoch: int = 0, finalized_epoch: int = 0,
+                 proposer_boost_score: int = 0):
+        self.nodes: list[Node] = []
+        self.index_by_root: dict[bytes, int] = {}
+        self.votes: dict[int, _Vote] = {}      # validator index -> vote
+        self.balances: np.ndarray = np.zeros(0, dtype=np.int64)
+        # balances as of the last applied pass (reference oldBalances):
+        # weight deltas subtract what was actually applied, not the
+        # current balance, so balance changes reconcile exactly
+        self._applied_balances: np.ndarray = np.zeros(0, dtype=np.int64)
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+        self.proposer_boost_root: bytes = b"\x00" * 32
+        self.proposer_boost_score = proposer_boost_score
+        self._boosted_root: bytes = b"\x00" * 32
+
+    # --- block insertion ---------------------------------------------------
+
+    def insert_node(self, slot: int, root: bytes, parent_root: bytes,
+                    justified_epoch: int, finalized_epoch: int) -> int:
+        if root in self.index_by_root:
+            return self.index_by_root[root]
+        parent = self.index_by_root.get(parent_root, NO_INDEX)
+        idx = len(self.nodes)
+        self.nodes.append(Node(slot=slot, root=root, parent=parent,
+                               justified_epoch=justified_epoch,
+                               finalized_epoch=finalized_epoch))
+        self.index_by_root[root] = idx
+        if parent != NO_INDEX:
+            self.nodes[parent].children.append(idx)
+            # incremental: only the ancestor chain of the new leaf can
+            # change (weights are untouched by insertion), keeping
+            # block import O(depth) not O(n)
+            self._update_ancestors(parent)
+        return idx
+
+    def has_node(self, root: bytes) -> bool:
+        return root in self.index_by_root
+
+    def node(self, root: bytes) -> Node:
+        return self.nodes[self.index_by_root[root]]
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    # --- votes -------------------------------------------------------------
+
+    def process_attestation(self, validator_index: int, block_root: bytes,
+                            target_epoch: int) -> None:
+        """Record an LMD vote (latest message wins by target epoch)."""
+        vote = self.votes.setdefault(validator_index, _Vote())
+        if target_epoch > vote.next_epoch:
+            vote.next_root = block_root
+            vote.next_epoch = target_epoch
+
+    def set_balances(self, balances) -> None:
+        """Justified-state effective balances (one per validator)."""
+        self.balances = np.asarray(balances, dtype=np.int64)
+
+    def apply_proposer_boost(self, root: bytes) -> None:
+        """Boost the current slot's timely proposal (spec proposer
+        boost; reference previousProposerBoostRoot handling)."""
+        self.proposer_boost_root = root
+
+    def reset_proposer_boost(self) -> None:
+        self.proposer_boost_root = b"\x00" * 32
+
+    # --- head --------------------------------------------------------------
+
+    def update_justified(self, justified_epoch: int,
+                         finalized_epoch: int) -> None:
+        if (justified_epoch != self.justified_epoch
+                or finalized_epoch != self.finalized_epoch):
+            self.justified_epoch = justified_epoch
+            self.finalized_epoch = finalized_epoch
+            self._refresh_best_pointers()
+
+    def head(self, justified_root: bytes | None = None) -> bytes:
+        """Apply pending vote deltas, then follow best descendants from
+        the justified root (or the tree root)."""
+        self._apply_score_changes()
+        if justified_root is not None:
+            start = self.index_by_root.get(justified_root)
+            if start is None:
+                raise KeyError("unknown justified root")
+        else:
+            start = self._tree_root_index()
+        best = self.nodes[start].best_descendant
+        if best == NO_INDEX:
+            best = start
+        return self.nodes[best].root
+
+    # --- pruning -----------------------------------------------------------
+
+    def prune(self, finalized_root: bytes) -> None:
+        """Drop everything not descending from the finalized root and
+        reindex (reference protoarray prune behavior)."""
+        fin = self.index_by_root.get(finalized_root)
+        if fin is None:
+            return
+        keep: set[int] = {fin}
+        for i, n in enumerate(self.nodes):
+            j = i
+            chain = []
+            while j != NO_INDEX and j not in keep:
+                chain.append(j)
+                j = self.nodes[j].parent
+            if j != NO_INDEX:            # reached a kept ancestor
+                keep.update(chain)
+        remap: dict[int, int] = {}
+        new_nodes: list[Node] = []
+        for i in sorted(keep):
+            remap[i] = len(new_nodes)
+            new_nodes.append(self.nodes[i])
+        for n in new_nodes:
+            n.parent = remap.get(n.parent, NO_INDEX)
+            n.children = [remap[c] for c in n.children if c in remap]
+        new_nodes[remap[fin]].parent = NO_INDEX
+        self.nodes = new_nodes
+        self.index_by_root = {n.root: i for i, n in enumerate(new_nodes)}
+        self._refresh_best_pointers()
+
+    def ancestor_at_slot(self, root: bytes, slot: int) -> bytes | None:
+        """get_ancestor analog: walk up to the block at/before slot."""
+        idx = self.index_by_root.get(root)
+        while idx is not None and idx != NO_INDEX:
+            node = self.nodes[idx]
+            if node.slot <= slot:
+                return node.root
+            idx = node.parent
+        return None
+
+    # --- internals ---------------------------------------------------------
+
+    def _tree_root_index(self) -> int:
+        for i, n in enumerate(self.nodes):
+            if n.parent == NO_INDEX:
+                return i
+        raise ValueError("empty fork choice store")
+
+    def _viable_for_head(self, node: Node) -> bool:
+        return ((node.justified_epoch == self.justified_epoch
+                 or self.justified_epoch == 0)
+                and (node.finalized_epoch == self.finalized_epoch
+                     or self.finalized_epoch == 0))
+
+    def _apply_score_changes(self) -> None:
+        """Convert vote movements into per-node weight deltas, then
+        back-propagate subtree weights and refresh best pointers
+        (reference applyWeightChanges)."""
+        deltas = np.zeros(len(self.nodes) + 1, dtype=np.int64)
+        changed = False
+        old_bals, new_bals = self._applied_balances, self.balances
+        for vi, vote in self.votes.items():
+            old_bal = int(old_bals[vi]) if vi < len(old_bals) else 0
+            new_bal = int(new_bals[vi]) if vi < len(new_bals) else 0
+            new_idx = self.index_by_root.get(vote.next_root)
+            if new_idx is None:
+                # target block not received yet (normal gossip
+                # ordering) — leave the vote pending; moving it now
+                # would re-subtract from the old node on every call
+                target_root = vote.current_root
+            else:
+                target_root = vote.next_root
+            if vote.current_root == target_root and old_bal == new_bal:
+                continue
+            old_idx = self.index_by_root.get(vote.current_root)
+            tgt_idx = self.index_by_root.get(target_root)
+            if old_idx is not None:
+                deltas[old_idx] -= old_bal
+                changed = True
+            if tgt_idx is not None:
+                deltas[tgt_idx] += new_bal
+                changed = True
+            vote.current_root = target_root
+        self._applied_balances = np.asarray(new_bals,
+                                            dtype=np.int64).copy()
+
+        if self.proposer_boost_root != self._boosted_root:
+            new_b = self.index_by_root.get(self.proposer_boost_root)
+            # only settle the boost once its target is known (or it
+            # was reset) — otherwise the boost would be lost if applied
+            # before the block insert
+            if new_b is not None or self.proposer_boost_root == b"\x00" * 32:
+                old_b = self.index_by_root.get(self._boosted_root)
+                if old_b is not None:
+                    deltas[old_b] -= self.proposer_boost_score
+                if new_b is not None:
+                    deltas[new_b] += self.proposer_boost_score
+                self._boosted_root = self.proposer_boost_root
+                changed = True
+
+        if not changed:
+            return
+
+        # children always have larger indices than parents, so one
+        # reverse pass adds each node's delta and pushes it to its
+        # parent — subtree weights in O(n)
+        for i in range(len(self.nodes) - 1, -1, -1):
+            d = int(deltas[i])
+            node = self.nodes[i]
+            node.weight += d
+            if node.parent != NO_INDEX:
+                deltas[node.parent] += d
+        self._refresh_best_pointers()
+
+    def _select_best(self, i: int) -> None:
+        """Recompute node i's best_child/best_descendant from its
+        children's (already current) pointers."""
+        node = self.nodes[i]
+        best_child = NO_INDEX
+        best_key = None
+        for c in node.children:
+            child = self.nodes[c]
+            tip = (child.best_descendant
+                   if child.best_descendant != NO_INDEX else c)
+            if not self._viable_for_head(self.nodes[tip]):
+                # spec filter_block_tree: a branch with no viable tip
+                # is excluded entirely — head() falls back to the
+                # start node rather than a filtered branch
+                continue
+            # compare the child's SUBTREE weight (protoarray
+            # semantics: weights are delta-propagated to parents)
+            key = (child.weight, child.root)
+            if best_key is None or key > best_key:
+                best_key = key
+                best_child = c
+        if best_child == NO_INDEX:
+            node.best_child = NO_INDEX
+            node.best_descendant = NO_INDEX
+        else:
+            node.best_child = best_child
+            bc = self.nodes[best_child]
+            node.best_descendant = (
+                bc.best_descendant
+                if bc.best_descendant != NO_INDEX else best_child)
+
+    def _update_ancestors(self, start: int) -> None:
+        """Refresh best pointers along one ancestor chain (leaf
+        insertion path)."""
+        i = start
+        while i != NO_INDEX:
+            self._select_best(i)
+            i = self.nodes[i].parent
+
+    def _refresh_best_pointers(self) -> None:
+        """Recompute best_child/best_descendant bottom-up from scratch
+        — robust to weight decreases and viability flips."""
+        for i in range(len(self.nodes) - 1, -1, -1):
+            self._select_best(i)
+
+
+__all__ = ["ForkChoiceStore", "Node", "NO_INDEX"]

@@ -1,0 +1,9 @@
+"""State generation: hot/cold storage + replay.
+
+Reference analog: ``beacon-chain/state/stategen`` (StateByRoot,
+ReplayBlocks, hot/cold split) [U, SURVEY.md §2 "stategen"].
+"""
+
+from .service import StateGen
+
+__all__ = ["StateGen"]

@@ -1,0 +1,91 @@
+"""Cache unit tests (LRU, committee, state caches)."""
+
+import pytest
+
+from prysm_tpu.cache import (
+    CheckpointStateCache, HotStateCache, LRUCache, committee_cache,
+)
+from prysm_tpu.cache.committee import Committees
+
+
+class TestLRU:
+    def test_basic_get_put(self):
+        c = LRUCache(2)
+        c.put("a", 1)
+        c.put("b", 2)
+        assert c.get("a") == 1
+        assert c.get("b") == 2
+        assert c.get("c") is None
+        assert c.hits == 2 and c.misses == 1
+
+    def test_eviction_order(self):
+        c = LRUCache(2)
+        c.put("a", 1)
+        c.put("b", 2)
+        c.get("a")              # refresh a
+        c.put("c", 3)           # evicts b
+        assert "a" in c and "c" in c and "b" not in c
+
+    def test_get_or_compute(self):
+        c = LRUCache(4)
+        calls = []
+        v = c.get_or_compute("k", lambda: calls.append(1) or 42)
+        assert v == 42 and len(calls) == 1
+        v = c.get_or_compute("k", lambda: calls.append(1) or 43)
+        assert v == 42 and len(calls) == 1
+
+    def test_zero_size_rejected(self):
+        with pytest.raises(ValueError):
+            LRUCache(0)
+
+
+class TestCommittees:
+    def test_committee_slicing_partitions_indices(self):
+        entry = Committees(seed=b"s" * 32,
+                           shuffled_indices=tuple(range(12)),
+                           committees_per_slot=2, slots_per_epoch=3)
+        seen = []
+        for slot in range(3):
+            for idx in range(2):
+                seen.extend(entry.committee(slot, idx))
+        assert sorted(seen) == list(range(12))
+        assert len(seen) == 12   # disjoint cover
+
+    def test_beacon_committee_uses_cache(self):
+        from prysm_tpu.config import use_minimal_config, use_mainnet_config
+        from prysm_tpu.core.helpers import get_beacon_committee
+        from prysm_tpu.testing.util import deterministic_genesis_state
+
+        use_minimal_config()
+        try:
+            committee_cache.clear()
+            state = deterministic_genesis_state(16)
+            before = committee_cache.misses
+            c1 = get_beacon_committee(state, 0, 0)
+            mid_hits = committee_cache.hits
+            c2 = get_beacon_committee(state, 0, 0)
+            assert committee_cache.misses == before + 1
+            assert committee_cache.hits == mid_hits + 1
+            assert c1 == c2 and len(c1) > 0
+        finally:
+            use_mainnet_config()
+            committee_cache.clear()
+
+
+class TestStateCaches:
+    def test_hot_state_roundtrip(self):
+        c = HotStateCache(2)
+        c.put(b"r1", {"slot": 1})
+        assert c.get(b"r1") == {"slot": 1}
+        assert c.has(b"r1") and not c.has(b"r2")
+
+    def test_checkpoint_state_key(self):
+        from prysm_tpu.proto import Checkpoint
+
+        c = CheckpointStateCache()
+        cp = Checkpoint(epoch=3, root=b"\x07" * 32)
+        c.put(cp, "state")
+        same = Checkpoint(epoch=3, root=b"\x07" * 32)
+        assert c.get(same) == "state"
+        other = Checkpoint(epoch=4, root=b"\x07" * 32)
+        assert c.get(other) is None

@@ -1,0 +1,131 @@
+"""db/kv persistence tests (BoltDB-analog store + BeaconDB)."""
+
+import pytest
+
+from prysm_tpu.config import use_mainnet_config, use_minimal_config
+from prysm_tpu.db import BeaconDB, KVStore, setup_db
+from prysm_tpu.db.kv import slot_key
+from prysm_tpu.proto import Checkpoint, build_types
+from prysm_tpu.testing import util as testutil
+
+
+class TestKVStore:
+    def test_bucket_roundtrip(self):
+        with KVStore() as kv:
+            b = kv.bucket("blocks")
+            b.put(b"k1", b"v1")
+            assert b.get(b"k1") == b"v1"
+            assert b.get(b"nope") is None
+            assert b.has(b"k1") and not b.has(b"k2")
+
+    def test_buckets_are_isolated(self):
+        with KVStore() as kv:
+            kv.bucket("a").put(b"k", b"in-a")
+            kv.bucket("b").put(b"k", b"in-b")
+            assert kv.bucket("a").get(b"k") == b"in-a"
+            assert kv.bucket("b").get(b"k") == b"in-b"
+
+    def test_batch_and_scan_ordering(self):
+        with KVStore() as kv:
+            b = kv.bucket("idx")
+            b.put_batch([(slot_key(s), str(s).encode())
+                         for s in (5, 1, 3, 9, 7)])
+            keys = [k for k, _ in b.scan(slot_key(2), slot_key(8))]
+            assert keys == [slot_key(3), slot_key(5), slot_key(7)]
+            assert b.last()[0] == slot_key(9)
+            assert b.count() == 5
+
+    def test_delete(self):
+        with KVStore() as kv:
+            b = kv.bucket("x")
+            b.put(b"k", b"v")
+            b.delete(b"k")
+            assert b.get(b"k") is None
+
+    def test_bad_bucket_name_rejected(self):
+        with KVStore() as kv:
+            with pytest.raises(ValueError):
+                kv.bucket("bad; DROP TABLE--")
+
+    def test_file_persistence(self, tmp_path):
+        path = str(tmp_path / "kv.db")
+        kv = KVStore(path)
+        kv.bucket("b").put(b"k", b"persisted")
+        kv.close()
+        kv2 = KVStore(path)
+        assert kv2.bucket("b").get(b"k") == b"persisted"
+        kv2.close()
+
+
+@pytest.fixture(scope="module")
+def minimal_env():
+    use_minimal_config()
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    types = build_types(MINIMAL_CONFIG)
+    genesis = testutil.deterministic_genesis_state(16, types)
+    yield types, genesis
+    use_mainnet_config()
+
+
+class TestBeaconDB:
+    def test_block_roundtrip(self, minimal_env, tmp_path):
+        types, genesis = minimal_env
+        db = setup_db(str(tmp_path), types=types)
+        st = genesis.copy()
+        blk = testutil.generate_full_block(st, slot=1)
+        root = db.save_block(blk)
+        assert db.has_block(root)
+        got = db.block(root)
+        assert got == blk
+        assert type(got.message).hash_tree_root(got.message) == root
+        db.close()
+
+    def test_blocks_by_range_and_highest(self, minimal_env):
+        types, genesis = minimal_env
+        db = setup_db(types=types)
+        from prysm_tpu.core.transition import state_transition
+
+        st = genesis.copy()
+        blocks = []
+        for slot in (1, 2, 3):
+            blk = testutil.generate_full_block(st, slot=slot)
+            state_transition(st, blk, types, verify_signatures=False)
+            blocks.append(blk)
+        db.save_blocks(blocks)
+        got = db.blocks_by_range(2, 4)
+        assert [b.message.slot for b in got] == [2, 3]
+        assert db.highest_slot_block().message.slot == 3
+        db.close()
+
+    def test_state_roundtrip(self, minimal_env):
+        types, genesis = minimal_env
+        db = setup_db(types=types)
+        root = b"\x01" * 32
+        db.save_state(genesis, root)
+        got = db.state(root)
+        assert types.BeaconState.hash_tree_root(got) == \
+            types.BeaconState.hash_tree_root(genesis)
+        assert db.state_summary_slot(root) == genesis.slot
+        assert db.state(b"\x02" * 32) is None
+        db.close()
+
+    def test_checkpoints_and_head(self, minimal_env):
+        types, _ = minimal_env
+        db = setup_db(types=types)
+        cp = Checkpoint(epoch=7, root=b"\x09" * 32)
+        db.save_justified_checkpoint(cp)
+        db.save_finalized_checkpoint(Checkpoint(epoch=5, root=b"\x08" * 32))
+        assert db.justified_checkpoint() == cp
+        assert db.finalized_checkpoint().epoch == 5
+        db.save_head_root(b"\x11" * 32)
+        assert db.head_root() == b"\x11" * 32
+        db.close()
+
+    def test_genesis_state_persist(self, minimal_env):
+        types, genesis = minimal_env
+        db = setup_db(types=types)
+        db.save_genesis_state(genesis)
+        got = db.genesis_state()
+        assert got.genesis_time == genesis.genesis_time
+        db.close()

@@ -1,0 +1,232 @@
+"""Fork choice (LMD-GHOST protoarray) unit tests.
+
+Mirrors the reference's protoarray test scenarios [U, SURVEY.md §2]:
+chain extension, vote-weighted fork resolution, latest-message
+semantics, justified-epoch filtering, proposer boost, pruning.
+"""
+
+import pytest
+
+from prysm_tpu.forkchoice import ForkChoiceStore
+
+
+def r(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def build_linear(store, n):
+    store.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+    for i in range(2, n + 1):
+        store.insert_node(i - 1, r(i), r(i - 1), 0, 0)
+
+
+class TestHead:
+    def test_single_chain_head_is_tip(self):
+        s = ForkChoiceStore()
+        build_linear(s, 5)
+        assert s.head() == r(5)
+
+    def test_fork_without_votes_tiebreaks_on_root(self):
+        s = ForkChoiceStore()
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)
+        # deterministic: larger root wins at equal weight
+        assert s.head() == r(3)
+
+    def test_votes_move_head(self):
+        s = ForkChoiceStore()
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)
+        s.set_balances([32, 32, 32])
+        s.process_attestation(0, r(2), 1)
+        s.process_attestation(1, r(2), 1)
+        s.process_attestation(2, r(3), 1)
+        assert s.head() == r(2)
+
+    def test_latest_message_wins(self):
+        s = ForkChoiceStore()
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)
+        s.set_balances([32])
+        s.process_attestation(0, r(2), 1)
+        assert s.head() == r(2)
+        s.process_attestation(0, r(3), 2)   # newer target epoch
+        assert s.head() == r(3)
+        s.process_attestation(0, r(2), 1)   # stale: ignored
+        assert s.head() == r(3)
+
+    def test_vote_weight_propagates_to_ancestors(self):
+        s = ForkChoiceStore()
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(2, r(4), r(2), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)
+        s.set_balances([32, 32, 32])
+        # two votes deep on the r(2) branch, one on r(3)
+        s.process_attestation(0, r(4), 1)
+        s.process_attestation(1, r(2), 1)
+        s.process_attestation(2, r(3), 1)
+        assert s.head() == r(4)
+        node2 = s.node(r(2))
+        assert node2.weight == 64
+
+    def test_head_from_justified_root(self):
+        s = ForkChoiceStore()
+        build_linear(s, 4)
+        s.insert_node(2, r(9), r(2), 0, 0)   # fork off r(2)
+        s.set_balances([32])
+        s.process_attestation(0, r(9), 1)
+        assert s.head(justified_root=r(3)) == r(4)
+
+    def test_justified_epoch_filters_nodes(self):
+        s = ForkChoiceStore(justified_epoch=1)
+        s.insert_node(0, r(1), b"\x00" * 32, 1, 0)
+        s.insert_node(1, r(2), r(1), 1, 0)
+        s.insert_node(1, r(3), r(1), 2, 0)   # from a different justified
+        s.update_justified(2, 0)
+        s.set_balances([32, 32])
+        # even with more weight, non-matching justified_epoch node r(2)
+        # is not viable for head
+        s.process_attestation(0, r(2), 1)
+        s.process_attestation(1, r(2), 1)
+        assert s.head() == r(3)
+
+
+class TestVoteEdgeCases:
+    def test_genesis_epoch_votes_count(self):
+        """target_epoch=0 attestations must register on fresh votes."""
+        s = ForkChoiceStore()
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)
+        s.set_balances([32])
+        s.process_attestation(0, r(2), 0)
+        assert s.head() == r(2)
+
+    def test_vote_for_unseen_block_is_pending_not_leaking(self):
+        """A vote whose target block hasn't arrived must not drain the
+        old node's weight on every head() call."""
+        s = ForkChoiceStore()
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)
+        s.set_balances([32, 32, 32])
+        s.process_attestation(0, r(2), 1)
+        s.process_attestation(1, r(2), 1)
+        assert s.head() == r(2)
+        # v0 re-votes for a block we haven't seen
+        s.process_attestation(0, r(9), 2)
+        for _ in range(5):
+            assert s.head() == r(2)
+        assert s.node(r(2)).weight == 64   # no repeated subtraction
+        # the block arrives as a child of r(3); the pending vote lands
+        s.insert_node(2, r(9), r(3), 0, 0)
+        s.process_attestation(2, r(3), 1)
+        assert s.head() == r(9)
+        assert s.node(r(2)).weight == 32
+
+
+class TestBalanceReconciliation:
+    def test_balance_drop_shrinks_unmoved_vote(self):
+        """A slashed/leaked validator's standing vote must lose weight
+        when balances refresh (reference old-vs-new balance deltas)."""
+        s = ForkChoiceStore()
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)
+        s.set_balances([32, 20, 20])
+        s.process_attestation(0, r(2), 1)
+        s.process_attestation(1, r(3), 1)
+        s.process_attestation(2, r(3), 1)
+        assert s.head() == r(3)            # 40 vs 32
+        s.set_balances([100, 20, 20])      # v0's balance grows
+        assert s.head() == r(2)            # 100 vs 40
+        assert s.node(r(2)).weight == 100
+        s.set_balances([10, 20, 20])       # v0 slashed down
+        assert s.head() == r(3)
+        assert s.node(r(2)).weight == 10   # no phantom weight
+
+    def test_balance_change_with_vote_move(self):
+        s = ForkChoiceStore()
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)
+        s.set_balances([32])
+        s.process_attestation(0, r(2), 1)
+        assert s.head() == r(2)
+        s.set_balances([16])
+        s.process_attestation(0, r(3), 2)
+        assert s.head() == r(3)
+        # old node must be fully drained (32 applied, 32 removed)
+        assert s.node(r(2)).weight == 0
+        assert s.node(r(3)).weight == 16
+
+
+class TestProposerBoost:
+    def test_boost_applied_before_block_arrives(self):
+        """Boost set during gossip validation must land when the block
+        is inserted afterwards, even if head() ran in between."""
+        s = ForkChoiceStore(proposer_boost_score=40)
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)
+        s.set_balances([32])
+        s.process_attestation(0, r(3), 1)
+        s.apply_proposer_boost(r(9))       # block not inserted yet
+        assert s.head() == r(3)            # boost pending, not lost
+        s.insert_node(2, r(9), r(2), 0, 0)
+        assert s.head() == r(9)            # boost (40) > vote (32)
+        s.reset_proposer_boost()
+        assert s.head() == r(3)
+
+    def test_boost_flips_tie(self):
+        s = ForkChoiceStore(proposer_boost_score=40)
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)
+        s.set_balances([32])
+        s.process_attestation(0, r(3), 1)
+        assert s.head() == r(3)
+        s.apply_proposer_boost(r(2))
+        assert s.head() == r(2)
+        s.reset_proposer_boost()
+        assert s.head() == r(3)
+
+
+class TestPrune:
+    def test_prune_drops_stale_branches(self):
+        s = ForkChoiceStore()
+        s.insert_node(0, r(1), b"\x00" * 32, 0, 0)
+        s.insert_node(1, r(2), r(1), 0, 0)
+        s.insert_node(2, r(4), r(2), 0, 0)
+        s.insert_node(1, r(3), r(1), 0, 0)   # will be pruned
+        s.prune(r(2))
+        assert s.has_node(r(2)) and s.has_node(r(4))
+        assert not s.has_node(r(3)) and not s.has_node(r(1))
+        assert s.head() == r(4)
+
+    def test_votes_survive_prune(self):
+        s = ForkChoiceStore()
+        build_linear(s, 3)
+        s.insert_node(3, r(5), r(3), 0, 0)
+        s.insert_node(3, r(6), r(3), 0, 0)
+        s.set_balances([32, 32, 32])
+        s.process_attestation(0, r(5), 1)
+        assert s.head() == r(5)
+        s.prune(r(3))
+        s.process_attestation(1, r(6), 1)
+        s.process_attestation(2, r(6), 1)
+        assert s.head() == r(6)
+
+
+class TestAncestor:
+    def test_ancestor_at_slot(self):
+        s = ForkChoiceStore()
+        build_linear(s, 5)
+        assert s.ancestor_at_slot(r(5), 2) == r(3)
+        assert s.ancestor_at_slot(r(5), 0) == r(1)
+        assert s.ancestor_at_slot(r(5), 4) == r(5)
+        assert s.ancestor_at_slot(b"\xff" * 32, 2) is None

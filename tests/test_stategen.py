@@ -1,0 +1,100 @@
+"""stategen tests: hot/cold storage, replay regeneration, resume."""
+
+import pytest
+
+from prysm_tpu.config import use_mainnet_config, use_minimal_config
+from prysm_tpu.core.transition import state_transition
+from prysm_tpu.db import setup_db
+from prysm_tpu.proto import build_types
+from prysm_tpu.stategen import StateGen
+from prysm_tpu.stategen.service import StateGenError
+from prysm_tpu.testing import util as testutil
+
+
+@pytest.fixture(scope="module")
+def env():
+    use_minimal_config()
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    types = build_types(MINIMAL_CONFIG)
+    genesis = testutil.deterministic_genesis_state(16, types)
+    # build a 6-block chain off genesis
+    db = setup_db(types=types)
+    gen = StateGen(db, types=types, snapshot_interval_epochs=1)
+    st = genesis.copy()
+    genesis_root = testutil._header_root_with_state(genesis)
+    db.save_state(genesis, genesis_root)
+    roots, states = [], []
+    for slot in range(1, 7):
+        blk = testutil.generate_full_block(st, slot=slot)
+        state_transition(st, blk, types, verify_signatures=False)
+        root = db.save_block(blk)
+        roots.append(root)
+        states.append(st.copy())
+    yield types, genesis, db, gen, roots, states
+    use_mainnet_config()
+
+
+class TestStateGen:
+    def test_regenerate_by_replay(self, env):
+        types, genesis, db, gen, roots, states = env
+        # no state saved for any block root: replay from genesis
+        got = gen.state_by_root(roots[3])
+        assert got.slot == states[3].slot
+        assert types.BeaconState.hash_tree_root(got) == \
+            types.BeaconState.hash_tree_root(states[3])
+
+    def test_cache_hit_after_regen(self, env):
+        types, genesis, db, gen, roots, states = env
+        gen.state_by_root(roots[2])
+        assert gen.hot_cache.has(roots[2])
+        got = gen.state_by_root(roots[2])
+        assert got.slot == states[2].slot
+
+    def test_cached_copy_is_isolated(self, env):
+        types, genesis, db, gen, roots, states = env
+        a = gen.state_by_root(roots[1])
+        a.slot = 9999
+        b = gen.state_by_root(roots[1])
+        assert b.slot == states[1].slot
+
+    def test_state_by_slot_advances(self, env):
+        types, genesis, db, gen, roots, states = env
+        got = gen.state_by_slot_along(roots[5], 10)
+        assert got.slot == 10
+        with pytest.raises(StateGenError):
+            gen.state_by_slot_along(roots[5], 2)
+
+    def test_unknown_root_raises(self, env):
+        types, genesis, db, gen, roots, states = env
+        with pytest.raises(StateGenError):
+            gen.state_by_root(b"\xfe" * 32)
+
+    def test_save_state_snapshot_policy(self, env):
+        types, genesis, db, gen, roots, states = env
+        # slot 6 is not a snapshot boundary (interval = 8 slots)
+        gen.save_state(states[5], roots[5])
+        assert db.state(roots[5]) is None          # summary only
+        assert db.state_summary_slot(roots[5]) == states[5].slot
+        # a boundary slot state persists fully
+        st8 = states[5].copy()
+        from prysm_tpu.core.transition import process_slots
+
+        process_slots(st8, 8, types)
+        gen.save_state(st8, b"\x88" * 32)
+        assert db.state(b"\x88" * 32) is not None
+
+    def test_on_finalized_persists_anchor(self, env):
+        types, genesis, db, gen, roots, states = env
+        gen.on_finalized(roots[4])
+        assert db.state(roots[4]) is not None
+        assert gen.finalized_slot == states[4].slot
+
+    def test_resume_from_db_only(self, env):
+        """Crash-recovery semantics: a fresh StateGen over the same DB
+        regenerates states with no in-memory context."""
+        types, genesis, db, gen, roots, states = env
+        fresh = StateGen(db, types=types)
+        got = fresh.state_by_root(roots[5])
+        assert types.BeaconState.hash_tree_root(got) == \
+            types.BeaconState.hash_tree_root(states[5])

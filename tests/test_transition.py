@@ -133,19 +133,24 @@ class TestEpochProcessing:
         assert st.slot == 8
         assert helpers.get_current_epoch(st) == 1
 
-    def test_justification_with_full_attestations(self, genesis, types):
-        """Three epochs of full blocks justify (and finalize) an epoch
-        (justification first evaluates at the epoch-2 boundary)."""
+    def test_justification_and_finality_with_full_attestations(
+            self, genesis, types):
+        """Full participation justifies at the 3rd epoch boundary
+        (spec: justification needs current>GENESIS+1) and finalizes at
+        the 4th (FFG rule: justified k,k+1 with matching old
+        checkpoint) — slots 23 and 31 on the minimal preset."""
         st = genesis.copy()
-        for slot in range(1, 25):
+        for slot in range(1, 34):
             blk = testutil.generate_full_block(st, slot=slot)
             state_transition(st, blk, types, verify_signatures=False)
-        assert st.current_justified_checkpoint.epoch >= 1
+        assert st.current_justified_checkpoint.epoch >= 2
         assert st.finalized_checkpoint.epoch >= 1
 
     def test_rewards_move_balances(self, genesis, types):
+        """Rewards first apply at the end of epoch 1 (the spec skips
+        rewards at the genesis-epoch boundary), i.e. past slot 16."""
         st = genesis.copy()
-        for slot in range(1, 10):
+        for slot in range(1, 18):
             blk = testutil.generate_full_block(st, slot=slot)
             state_transition(st, blk, types, verify_signatures=False)
         cfg_max = 32 * 10 ** 9
