@@ -1,0 +1,10 @@
+"""Blockchain service: block receipt, head management, event feed.
+
+Reference analog: ``beacon-chain/blockchain`` (ReceiveBlock/onBlock/
+updateHead) [U, SURVEY.md §2 "blockchain svc", §3.2].
+"""
+
+from .service import BlockchainService, BlockProcessingError
+from .events import EventFeed
+
+__all__ = ["BlockchainService", "BlockProcessingError", "EventFeed"]

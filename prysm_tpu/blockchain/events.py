@@ -1,0 +1,39 @@
+"""Event feed: typed pub/sub inside one node.
+
+Reference analog: Prysm's ``async/event.Feed`` (head updates, block
+processed, finalized checkpoint) [U, SURVEY.md §2 "runtime/async"].
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Callable
+
+EVENT_HEAD = "head"
+EVENT_BLOCK = "block_processed"
+EVENT_FINALIZED = "finalized"
+EVENT_ATTESTATION = "attestation"
+EVENT_CHAIN_STARTED = "chain_started"
+
+
+class EventFeed:
+    def __init__(self):
+        self._subs: dict[str, list[Callable[[Any], None]]] = \
+            defaultdict(list)
+        self._lock = threading.RLock()
+
+    def subscribe(self, event: str, fn: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._subs[event].append(fn)
+
+    def unsubscribe(self, event: str, fn: Callable[[Any], None]) -> None:
+        with self._lock:
+            if fn in self._subs.get(event, []):
+                self._subs[event].remove(fn)
+
+    def publish(self, event: str, payload: Any = None) -> None:
+        with self._lock:
+            subs = list(self._subs.get(event, []))
+        for fn in subs:
+            fn(payload)

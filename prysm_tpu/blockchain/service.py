@@ -1,0 +1,198 @@
+"""Blockchain service: the receive-block orchestration.
+
+Reference analog: ``beacon-chain/blockchain`` [U, SURVEY.md §2, §3.2]:
+
+    ReceiveBlock -> onBlock:
+      batch signature verification (ONE SignatureBatch per block —
+      the reference's BatchVerifier path; our batch dispatches to the
+      TPU backend when features().bls_implementation == 'xla')
+      -> ExecuteStateTransition (signatures already verified)
+      -> forkchoice insert + vote processing
+      -> db save + stategen save
+      -> updateHead -> event feed
+
+Justification/finalization updates propagate to fork choice and
+trigger stategen cold-migration + fork-choice pruning.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..config import beacon_config
+from ..core.helpers import (
+    compute_epoch_at_slot, get_attesting_indices,
+)
+from ..core.transition import (
+    StateTransitionError, collect_block_signature_batch,
+    state_transition,
+)
+from ..forkchoice import ForkChoiceStore
+from ..blockchain.events import (
+    EVENT_BLOCK, EVENT_FINALIZED, EVENT_HEAD, EventFeed,
+)
+
+
+class BlockProcessingError(Exception):
+    pass
+
+
+class BlockchainService:
+    def __init__(self, db, stategen, genesis_state, genesis_root: bytes,
+                 event_feed: EventFeed | None = None, metrics=None,
+                 types=None):
+        self.db = db
+        self.stategen = stategen
+        self.types = types or db.types
+        self.events = event_feed or EventFeed()
+        self.metrics = metrics
+        self.genesis_root = genesis_root
+
+        self.forkchoice = ForkChoiceStore()
+        self.forkchoice.insert_node(
+            slot=genesis_state.slot, root=genesis_root,
+            parent_root=b"\x00" * 32, justified_epoch=0,
+            finalized_epoch=0)
+        self.forkchoice.set_balances(
+            [v.effective_balance for v in genesis_state.validators])
+
+        self.head_root = genesis_root
+        self.head_state = genesis_state.copy()
+        self.justified_checkpoint = genesis_state.current_justified_checkpoint
+        self.finalized_checkpoint = genesis_state.finalized_checkpoint
+
+        self.db.save_state(genesis_state, genesis_root)
+        self.db.save_genesis_state(genesis_state)
+        self.stategen.save_state(genesis_state, genesis_root)
+
+    # --- block path --------------------------------------------------------
+
+    def receive_block(self, signed_block, verify_signatures: bool = True):
+        """ReceiveBlock/onBlock analog.  Raises BlockProcessingError
+        on any invalid block."""
+        t0 = time.perf_counter()
+        block = signed_block.message
+        block_root = type(block).hash_tree_root(block)
+        if self.db.has_block(block_root):
+            return block_root    # duplicate
+
+        parent_root = block.parent_root
+        try:
+            pre_state = self.stategen.state_by_root(parent_root)
+        except Exception as e:
+            raise BlockProcessingError(
+                f"unknown parent {parent_root.hex()[:16]}") from e
+
+        # 1. whole-block signature batch: ONE device dispatch
+        if verify_signatures:
+            work = pre_state.copy()
+            if work.slot < block.slot:
+                from ..core.transition import process_slots
+
+                process_slots(work, block.slot, self.types)
+            try:
+                batch = collect_block_signature_batch(work, signed_block)
+            except (ValueError, StateTransitionError) as e:
+                # malformed signature/pubkey bytes or bad structure
+                raise BlockProcessingError(
+                    f"signature batch collection failed: {e}") from e
+            if not batch.verify():
+                raise BlockProcessingError("block signature batch invalid")
+
+        # 2. transition (signatures verified above)
+        try:
+            post = state_transition(
+                pre_state, signed_block, self.types,
+                verify_signatures=False)
+        except StateTransitionError as e:
+            raise BlockProcessingError(str(e)) from e
+
+        # 3. persistence
+        self.db.save_block(signed_block)
+        self.stategen.save_state(post, block_root)
+
+        # 4. fork choice: insert + attestation votes
+        self.forkchoice.insert_node(
+            slot=block.slot, root=block_root, parent_root=parent_root,
+            justified_epoch=post.current_justified_checkpoint.epoch,
+            finalized_epoch=post.finalized_checkpoint.epoch)
+        for att in block.body.attestations:
+            self.process_attestation_votes(post, att)
+
+        # 5. checkpoint bookkeeping
+        self._update_checkpoints(post)
+
+        # 6. head update
+        self.update_head()
+        self.events.publish(EVENT_BLOCK, {
+            "root": block_root, "slot": block.slot})
+        if self.metrics is not None:
+            self.metrics.observe("block_processing_seconds",
+                                 time.perf_counter() - t0)
+        return block_root
+
+    def process_attestation_votes(self, state, attestation) -> None:
+        """Feed an attestation's LMD votes to fork choice (used for
+        both block and gossip attestations)."""
+        try:
+            indices = get_attesting_indices(
+                state, attestation.data, attestation.aggregation_bits)
+        except Exception:
+            return
+        for vi in indices:
+            self.forkchoice.process_attestation(
+                vi, attestation.data.beacon_block_root,
+                attestation.data.target.epoch)
+
+    def _update_checkpoints(self, post) -> None:
+        if (post.current_justified_checkpoint.epoch
+                > self.justified_checkpoint.epoch):
+            self.justified_checkpoint = post.current_justified_checkpoint
+            self.db.save_justified_checkpoint(self.justified_checkpoint)
+            self.forkchoice.update_justified(
+                self.justified_checkpoint.epoch,
+                self.finalized_checkpoint.epoch)
+            # refresh vote weights from the justified state's balances
+            self.forkchoice.set_balances(
+                [v.effective_balance for v in post.validators])
+        if (post.finalized_checkpoint.epoch
+                > self.finalized_checkpoint.epoch):
+            self.finalized_checkpoint = post.finalized_checkpoint
+            self.db.save_finalized_checkpoint(self.finalized_checkpoint)
+            self.forkchoice.update_justified(
+                self.justified_checkpoint.epoch,
+                self.finalized_checkpoint.epoch)
+            fin_root = self.finalized_checkpoint.root
+            if self.forkchoice.has_node(fin_root):
+                self.stategen.on_finalized(fin_root)
+                self.forkchoice.prune(fin_root)
+            self.events.publish(EVENT_FINALIZED, {
+                "epoch": self.finalized_checkpoint.epoch,
+                "root": fin_root})
+
+    def update_head(self) -> None:
+        justified_root = self.justified_checkpoint.root
+        if not self.forkchoice.has_node(justified_root):
+            justified_root = None
+        new_head = self.forkchoice.head(justified_root)
+        if new_head != self.head_root:
+            self.head_root = new_head
+            self.head_state = self.stategen.state_by_root(new_head)
+            self.db.save_head_root(new_head)
+            self.events.publish(EVENT_HEAD, {
+                "root": new_head, "slot": self.head_state.slot})
+
+    # --- queries -----------------------------------------------------------
+
+    def head(self) -> tuple[bytes, object]:
+        return self.head_root, self.head_state
+
+    def head_slot(self) -> int:
+        return self.head_state.slot
+
+    def current_slot_at(self, unix_time: float) -> int:
+        cfg = beacon_config()
+        genesis_time = self.head_state.genesis_time
+        if unix_time < genesis_time:
+            return 0
+        return int(unix_time - genesis_time) // cfg.seconds_per_slot

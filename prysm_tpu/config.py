@@ -159,3 +159,14 @@ _features = FeatureFlags()
 
 def features() -> FeatureFlags:
     return _features
+
+
+def set_features(**kwargs) -> FeatureFlags:
+    """CLI/flag surface: update feature flags in place
+    (features.ConfigureBeaconChain analog)."""
+    global _features
+    for k, v in kwargs.items():
+        if not hasattr(_features, k):
+            raise ValueError(f"unknown feature flag {k!r}")
+        setattr(_features, k, v)
+    return _features

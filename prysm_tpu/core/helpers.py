@@ -334,6 +334,25 @@ def compute_signing_root(obj, domain: bytes) -> bytes:
     return SigningData(object_root=obj.root(), domain=domain).root()
 
 
+def latest_header_root(state) -> bytes:
+    """Root of the state's latest block header with its state_root
+    filled in — the canonical root of the block that produced
+    ``state`` (the spec's get_ancestor base case; for a genesis state
+    this is the genesis block root)."""
+    from ..proto import BeaconBlockHeader
+
+    header = state.latest_block_header
+    if header.state_root == b"\x00" * 32:
+        header = BeaconBlockHeader(
+            slot=header.slot,
+            proposer_index=header.proposer_index,
+            parent_root=header.parent_root,
+            state_root=type(state).hash_tree_root(state),
+            body_root=header.body_root,
+        )
+    return header.root()
+
+
 # --- attestations ----------------------------------------------------------
 
 

@@ -1,0 +1,11 @@
+"""Monitoring: metrics registry + tracing spans.
+
+Reference analog: ``monitoring/prometheus`` + ``monitoring/tracing``
+(opencensus) [U, SURVEY.md §2 "monitoring", §5].
+"""
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, metrics
+from .tracing import span, enable_jax_trace
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "metrics", "span", "enable_jax_trace"]

@@ -1,0 +1,149 @@
+"""Prometheus-style metrics registry.
+
+Reference analog: the per-package prometheus counters/gauges/
+histograms and the /metrics text endpoint [U, SURVEY.md §2
+"monitoring", §5 "Metrics/logging"].  The BASELINE metrics of record —
+``bls_sigs_per_sec_per_chip`` and ``slot_verify_latency_seconds``
+(p50 via histogram) — are first-class here.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from dataclasses import dataclass, field
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {self.value}\n")
+
+
+class Gauge:
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {self.value}\n")
+
+
+_DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram:
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+        self.samples: list[float] = []   # bounded reservoir for p50
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            i = bisect_right(self.buckets, v)
+            self.counts[i] += 1
+            self.total += v
+            self.n += 1
+            if len(self.samples) < 4096:
+                self.samples.append(v)
+            else:
+                self.samples[self.n % 4096] = v
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self.samples:
+                return 0.0
+            s = sorted(self.samples)
+            return s[min(len(s) - 1, int(q * len(s)))]
+
+    def p50(self) -> float:
+        return self.quantile(0.5)
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        cum = 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.n}')
+        out.append(f"{self.name}_sum {self.total}")
+        out.append(f"{self.name}_count {self.n}")
+        return "\n".join(out) + "\n"
+
+
+class MetricsRegistry:
+    """Named metric registry with a text exposition endpoint."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.RLock()
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._get_or_make(name, Counter, help_text)
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._get_or_make(name, Gauge, help_text)
+
+    def histogram(self, name: str, help_text: str = "") -> Histogram:
+        return self._get_or_make(name, Histogram, help_text)
+
+    def _get_or_make(self, name, cls, help_text):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_text)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}")
+            return m
+
+    # convenience used by services ------------------------------------------
+
+    def inc(self, name: str, amount: float = 1.0) -> None:
+        self.counter(name).inc(amount)
+
+    def observe(self, name: str, v: float) -> None:
+        self.histogram(name).observe(v)
+
+    def set(self, name: str, v: float) -> None:
+        self.gauge(name).set(v)
+
+    def render(self) -> str:
+        """Prometheus text exposition (served at /metrics)."""
+        with self._lock:
+            parts = [m.render() for _, m in sorted(self._metrics.items())]
+        return "".join(parts)
+
+
+# process-global default registry (reference uses the prometheus
+# default registerer the same way)
+metrics = MetricsRegistry()

@@ -1,0 +1,83 @@
+"""Tracing: nested host spans + optional device profiling.
+
+Reference analog: opencensus spans through every service hot path
+(``trace.StartSpan(ctx, "blockChain.onBlock")``) exported to Jaeger
+[U, SURVEY.md §5 "Tracing/profiling"].  Here: a contextvar span stack
+recording wall times (queryable in tests, dumpable as JSON), plus
+``jax.profiler`` trace-annotation integration for device timelines
+(the XProf/Perfetto analog of the reference's Jaeger export).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import threading
+import time
+
+_stack: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "span_stack", default=())
+
+_records: list[dict] = []
+_records_lock = threading.Lock()
+_enabled = False
+_jax_trace = False
+
+
+def enable_tracing(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def enable_jax_trace(on: bool = True) -> None:
+    """Also emit jax.profiler TraceAnnotations so spans show up on the
+    device timeline when a profiler session is active."""
+    global _jax_trace
+    _jax_trace = on
+
+
+def clear() -> None:
+    with _records_lock:
+        _records.clear()
+
+
+def records() -> list[dict]:
+    with _records_lock:
+        return list(_records)
+
+
+def dump_json() -> str:
+    return json.dumps(records())
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """with span("blockchain.on_block"): ... — nesting is recorded via
+    dotted paths like the reference's span hierarchy."""
+    if not _enabled:
+        yield
+        return
+    parent = _stack.get()
+    path = parent + (name,)
+    token = _stack.set(path)
+    ann = None
+    if _jax_trace:
+        try:
+            import jax.profiler
+
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        except Exception:
+            ann = None
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        _stack.reset(token)
+        with _records_lock:
+            _records.append({
+                "span": ".".join(path), "seconds": dt, **attrs})

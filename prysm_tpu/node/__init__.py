@@ -1,0 +1,9 @@
+"""Beacon node assembly.
+
+Reference analog: ``beacon-chain/node`` + ``cmd/beacon-chain`` [U,
+SURVEY.md §2 "node assembly", §3.1].
+"""
+
+from .node import BeaconNode
+
+__all__ = ["BeaconNode"]

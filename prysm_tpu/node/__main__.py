@@ -1,0 +1,96 @@
+"""CLI: in-process multi-node demo + flag surface.
+
+Reference analog: ``cmd/beacon-chain`` urfave/cli flags [U, SURVEY.md
+§2 "binaries/CLI", §5 "Config/flags"]; notable parity flags:
+``--bls-implementation={pure,xla}`` (the north-star selector),
+``--minimal-config``, ``--enable-tracing``.
+
+``python -m prysm_tpu.node --nodes 2 --slots 4`` spins up N in-process
+nodes on a fake gossip bus (epochs of seconds, minimal preset),
+proposes real signed blocks, gossips them, and reports head consensus
+— the smallest end-to-end liveness demo (SURVEY §4 "Distributed").
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="prysm_tpu.node",
+        description="TPU-native beacon node (in-process demo harness)")
+    p.add_argument("--nodes", type=int, default=2,
+                   help="number of in-process nodes on the bus")
+    p.add_argument("--slots", type=int, default=4,
+                   help="number of slots to run")
+    p.add_argument("--validators", type=int, default=16,
+                   help="validator count (deterministic keys)")
+    p.add_argument("--bls-implementation", choices=("pure", "xla"),
+                   default="pure",
+                   help="BLS backend (north-star feature flag)")
+    p.add_argument("--minimal-config", action="store_true", default=True,
+                   help="use the minimal preset (default for the demo)")
+    p.add_argument("--enable-tracing", action="store_true")
+    p.add_argument("--metrics", action="store_true",
+                   help="print the /metrics exposition at the end")
+    args = p.parse_args(argv)
+
+    from ..config import (
+        set_features, use_minimal_config,
+    )
+
+    use_minimal_config()
+    set_features(bls_implementation=args.bls_implementation,
+                 enable_tracing=args.enable_tracing)
+    if args.enable_tracing:
+        from ..monitoring.tracing import enable_tracing
+
+        enable_tracing(True)
+
+    from ..config import MINIMAL_CONFIG
+    from ..proto import build_types
+    from ..testing.util import (
+        deterministic_genesis_state, generate_full_block,
+    )
+    from ..core.transition import state_transition
+    from ..p2p import GossipBus, TOPIC_BLOCK
+    from .node import BeaconNode
+
+    types = build_types(MINIMAL_CONFIG)
+    genesis = deterministic_genesis_state(args.validators, types)
+    genesis.genesis_time = int(time.time())
+
+    bus = GossipBus()
+    nodes = [BeaconNode(bus, f"node-{i}", genesis, types=types)
+             for i in range(args.nodes)]
+    for n in nodes:
+        n.start()
+    print(f"started {args.nodes} nodes, {args.validators} validators, "
+          f"bls={args.bls_implementation}")
+
+    st = genesis.copy()
+    proposer_node = nodes[0]
+    for slot in range(1, args.slots + 1):
+        blk = generate_full_block(st, slot=slot)
+        state_transition(st, blk, types, verify_signatures=False)
+        proposer_node.chain.receive_block(blk)
+        proposer_node.peer.broadcast(
+            TOPIC_BLOCK, types.SignedBeaconBlock.serialize(blk))
+        heads = {n.node_id: n.head_slot() for n in nodes}
+        print(f"slot {slot}: heads={heads}")
+
+    roots = {n.head_root() for n in nodes}
+    ok = len(roots) == 1
+    print("consensus:", "OK" if ok else f"SPLIT ({len(roots)} heads)")
+    if args.metrics:
+        print(nodes[0].metrics.render())
+    for n in nodes:
+        n.stop()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""BeaconNode: construct + wire services in dependency order.
+
+Reference analog: ``node.New`` building the registry — db, p2p,
+blockchain, sync, operations pools, rpc, monitoring — then
+``registry.StartAll`` [U, SURVEY.md §2, §3.1].  The p2p transport is
+the in-process gossip bus (real networking is host-side and out of
+TPU scope, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..blockchain import BlockchainService, EventFeed
+from ..config import beacon_config, features
+from ..db import BeaconDB
+from ..monitoring import MetricsRegistry
+from ..operations import (
+    AttestationPool, SlashingPool, VoluntaryExitPool,
+)
+from ..p2p import GossipBus
+from ..proto import active_types
+from ..runtime import ServiceRegistry, SlotTicker
+from ..core.helpers import latest_header_root
+from ..stategen import StateGen
+from ..sync import SyncService
+
+
+class _NullService:
+    """Adapter for components without lifecycle needs."""
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+class BeaconNode:
+    """One in-process beacon node on a gossip bus."""
+
+    def __init__(self, bus: GossipBus, node_id: str, genesis_state,
+                 db_path: str = ":memory:", types=None,
+                 time_fn=time.time):
+        self.node_id = node_id
+        self.types = types or active_types()
+        self.metrics = MetricsRegistry()
+        self.events = EventFeed()
+        self.registry = ServiceRegistry()
+        self.time_fn = time_fn
+
+        self.db = BeaconDB(db_path, types=self.types)
+        self.stategen = StateGen(self.db, types=self.types)
+        genesis_root = latest_header_root(genesis_state)
+        self.chain = BlockchainService(
+            self.db, self.stategen, genesis_state.copy(), genesis_root,
+            event_feed=self.events, metrics=self.metrics,
+            types=self.types)
+
+        self.att_pool = AttestationPool()
+        self.slashing_pool = SlashingPool()
+        self.exit_pool = VoluntaryExitPool()
+
+        self.peer = bus.join(node_id)
+        self.sync = SyncService(self.peer, self.chain, self.att_pool,
+                                types=self.types, metrics=self.metrics)
+        self.ticker = SlotTicker(genesis_state.genesis_time,
+                                 self._on_slot, time_fn=time_fn)
+
+        # registration order IS dependency order
+        self.registry.register("db", _NullService(self.db))
+        self.registry.register("stategen", _NullService(self.stategen))
+        self.registry.register("blockchain", _NullService(self.chain))
+        self.registry.register("sync", self.sync)
+        self.registry.register("ticker", self.ticker)
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.registry.start_all()
+
+    def stop(self) -> None:
+        self.registry.stop_all()
+        self.db.close()
+
+    # --- slot duties -------------------------------------------------------
+
+    def _on_slot(self, slot: int) -> None:
+        """Per-slot housekeeping: aggregate the pool, verify the
+        previous slot's accumulated batch in ONE dispatch, prune."""
+        cfg = beacon_config()
+        self.metrics.set("current_slot", slot)
+        self.sync.retry_pending()
+        self.att_pool.aggregate_unaggregated()
+        if slot >= 1:
+            t0 = time.perf_counter()
+            ok = self.sync.verify_slot_batch(slot - 1)
+            self.metrics.observe("slot_verify_latency_seconds",
+                                 time.perf_counter() - t0)
+            if not ok:
+                self.metrics.inc("slot_batch_failures")
+        retention = cfg.slots_per_epoch
+        if slot > retention:
+            self.att_pool.prune_before(slot - retention)
+
+    # --- convenience -------------------------------------------------------
+
+    def head_slot(self) -> int:
+        return self.chain.head_slot()
+
+    def head_root(self) -> bytes:
+        return self.chain.head_root

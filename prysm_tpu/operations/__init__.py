@@ -1,0 +1,11 @@
+"""Operation pools (attestations, slashings, exits).
+
+Reference analog: ``beacon-chain/operations/`` [U, SURVEY.md §2
+"operations/attestations", "operations/slashings, voluntaryexits"].
+"""
+
+from .attestations import AttestationPool
+from .slashings import SlashingPool
+from .voluntaryexits import VoluntaryExitPool
+
+__all__ = ["AttestationPool", "SlashingPool", "VoluntaryExitPool"]

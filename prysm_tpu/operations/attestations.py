@@ -1,0 +1,215 @@
+"""Attestation pool + aggregator + whole-slot batch accumulation.
+
+Reference analog: ``beacon-chain/operations/attestations`` (+ ``kv/``)
+[U, SURVEY.md §2, §3.3]: unaggregated and aggregated maps keyed by
+(slot, committee index, beacon block root); a background aggregator
+merges bitfields and BLS-aggregates signatures per group.
+
+North-star change (SURVEY §3.3): instead of verifying each gossip
+attestation with its own pairing, the pool accumulates a *slot batch*
+— every attestation's (aggregate pubkey, message root, signature)
+triple — and the sync/blockchain service dispatches ONE device
+verification per slot (``build_slot_signature_batch``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from dataclasses import dataclass
+
+from ..config import beacon_config
+from ..core.helpers import (
+    compute_signing_root, get_beacon_committee, get_domain,
+)
+from ..crypto.bls import bls
+from ..proto import Attestation, AttestationData
+
+
+class AttestationPoolError(Exception):
+    pass
+
+
+def _group_key(att: Attestation) -> tuple[int, int, bytes]:
+    return (att.data.slot, att.data.index, att.data.beacon_block_root)
+
+
+def _bits_overlap(a, b) -> bool:
+    return any(x and y for x, y in zip(a, b))
+
+
+def _bits_subset(a, b) -> bool:
+    """a ⊆ b."""
+    return all((not x) or y for x, y in zip(a, b))
+
+
+def _merge_bits(a, b) -> list[bool]:
+    return [x or y for x, y in zip(a, b)]
+
+
+@dataclass
+class _Group:
+    unaggregated: list[Attestation]
+    aggregated: list[Attestation]
+
+
+class AttestationPool:
+    """Pool of seen-but-not-yet-included attestations."""
+
+    def __init__(self):
+        self._groups: dict[tuple[int, int, bytes], _Group] = \
+            defaultdict(lambda: _Group([], []))
+        self._lock = threading.RLock()
+        # forkchoice-only attestations (seen in blocks) kept for vote
+        # accounting parity with the reference's block-att map
+        self.block_attestations: list[Attestation] = []
+
+    # --- ingest ------------------------------------------------------------
+
+    def save_unaggregated(self, att: Attestation) -> None:
+        if sum(att.aggregation_bits) != 1:
+            raise AttestationPoolError(
+                "unaggregated attestation must have exactly one bit")
+        with self._lock:
+            g = self._groups[_group_key(att)]
+            if any(att.aggregation_bits == e.aggregation_bits
+                   and att.data == e.data for e in g.unaggregated):
+                return
+            g.unaggregated.append(att)
+
+    def save_aggregated(self, att: Attestation) -> None:
+        if sum(att.aggregation_bits) < 1:
+            raise AttestationPoolError("empty aggregation bits")
+        with self._lock:
+            g = self._groups[_group_key(att)]
+            # drop if already covered by an existing aggregate
+            for e in g.aggregated:
+                if _bits_subset(att.aggregation_bits, e.aggregation_bits):
+                    return
+            g.aggregated = [
+                e for e in g.aggregated
+                if not _bits_subset(e.aggregation_bits,
+                                    att.aggregation_bits)]
+            g.aggregated.append(att)
+
+    def save_block_attestation(self, att: Attestation) -> None:
+        with self._lock:
+            self.block_attestations.append(att)
+
+    # --- aggregation (the reference's background aggregator) ---------------
+
+    def aggregate_unaggregated(self) -> None:
+        """Merge single-bit attestations into aggregates per group
+        (greedy non-overlapping merge + BLS signature aggregation —
+        AggregateUnaggregatedAttestations analog)."""
+        with self._lock:
+            for key, g in self._groups.items():
+                if not g.unaggregated:
+                    continue
+                pending = list(g.unaggregated)
+                g.unaggregated = []
+                for att in pending:
+                    if any(_bits_subset(att.aggregation_bits,
+                                        agg.aggregation_bits)
+                           for agg in g.aggregated):
+                        continue   # already covered: drop, don't dup
+                    merged = False
+                    for i, agg in enumerate(g.aggregated):
+                        if _bits_overlap(att.aggregation_bits,
+                                         agg.aggregation_bits):
+                            continue
+                        sig = bls.Signature.aggregate([
+                            bls.Signature.from_bytes(agg.signature),
+                            bls.Signature.from_bytes(att.signature)])
+                        g.aggregated[i] = Attestation(
+                            aggregation_bits=_merge_bits(
+                                agg.aggregation_bits,
+                                att.aggregation_bits),
+                            data=agg.data,
+                            signature=sig.to_bytes())
+                        merged = True
+                        break
+                    if not merged:
+                        g.aggregated.append(att)
+
+    # --- queries -----------------------------------------------------------
+
+    def aggregated_for_block(self, slot: int | None = None,
+                             limit: int | None = None
+                             ) -> list[Attestation]:
+        """Best aggregates for block inclusion, most-bits-first
+        (proposer packing order)."""
+        cfg = beacon_config()
+        limit = limit if limit is not None else cfg.max_attestations
+        with self._lock:
+            out: list[Attestation] = []
+            for key, g in self._groups.items():
+                if slot is not None and key[0] != slot:
+                    continue
+                out.extend(g.aggregated)
+            out.sort(key=lambda a: -sum(a.aggregation_bits))
+            return out[:limit]
+
+    def unaggregated_count(self) -> int:
+        with self._lock:
+            return sum(len(g.unaggregated)
+                       for g in self._groups.values())
+
+    def aggregated_count(self) -> int:
+        with self._lock:
+            return sum(len(g.aggregated) for g in self._groups.values())
+
+    def groups_for_slot(self, slot: int):
+        with self._lock:
+            return {k: g for k, g in self._groups.items()
+                    if k[0] == slot}
+
+    def prune_before(self, slot: int) -> None:
+        """Drop attestations older than ``slot`` (one-epoch retention
+        in the reference)."""
+        with self._lock:
+            for key in [k for k in self._groups if k[0] < slot]:
+                del self._groups[key]
+            self.block_attestations = [
+                a for a in self.block_attestations
+                if a.data.slot >= slot]
+
+    # --- north-star: whole-slot signature batch ----------------------------
+
+    def build_slot_signature_batch(self, state, slot: int
+                                   ) -> bls.SignatureBatch:
+        """Accumulate every pool attestation of ``slot`` into ONE
+        SignatureBatch: per attestation, the aggregate pubkey of its
+        set bits + the attestation signing root + its signature.  The
+        caller dispatches a single randomized-linear-combination
+        verification to the device (SURVEY §3.3 north-star change)."""
+        cfg = beacon_config()
+        batch = bls.SignatureBatch()
+        with self._lock:
+            for (s, index, _root), g in self._groups.items():
+                if s != slot:
+                    continue
+                try:
+                    committee = get_beacon_committee(state, s, index)
+                except Exception:
+                    continue   # committee no longer derivable
+                for att in g.aggregated + g.unaggregated:
+                    if len(att.aggregation_bits) != len(committee):
+                        # shuffling changed since gossip acceptance —
+                        # skipping avoids truncating bits into a wrong
+                        # aggregate key that would poison the batch
+                        continue
+                    signers = [v for v, bit
+                               in zip(committee, att.aggregation_bits)
+                               if bit]
+                    if not signers:
+                        continue
+                    pks = [bls.PublicKey.from_bytes(
+                        state.validators[v].pubkey) for v in signers]
+                    domain = get_domain(state, cfg.domain_beacon_attester,
+                                        att.data.target.epoch)
+                    root = compute_signing_root(att.data, domain)
+                    batch.add(bls.Signature.from_bytes(att.signature),
+                              root, bls.PublicKey.aggregate(pks),
+                              f"attestation s={s} c={index}")
+        return batch

@@ -1,0 +1,90 @@
+"""Slashing operation pools.
+
+Reference analog: ``beacon-chain/operations/slashings`` [U, SURVEY.md
+§2]: pending proposer/attester slashings awaiting block inclusion,
+deduplicated by the validators they slash.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..core.helpers import (
+    get_current_epoch, is_slashable_validator,
+)
+
+
+class SlashingPool:
+    def __init__(self):
+        self._proposer: dict[int, object] = {}   # proposer idx -> op
+        self._attester: list[object] = []
+        self._attester_covered: set[int] = set()
+        self._lock = threading.RLock()
+
+    # --- proposer slashings ------------------------------------------------
+
+    def insert_proposer_slashing(self, state, slashing) -> bool:
+        idx = slashing.signed_header_1.message.proposer_index
+        with self._lock:
+            if idx in self._proposer:
+                return False
+            if idx >= len(state.validators):
+                return False
+            if not is_slashable_validator(state.validators[idx],
+                                          get_current_epoch(state)):
+                return False
+            self._proposer[idx] = slashing
+            return True
+
+    def pending_proposer_slashings(self, limit: int | None = None):
+        with self._lock:
+            out = list(self._proposer.values())
+        return out[:limit] if limit is not None else out
+
+    # --- attester slashings ------------------------------------------------
+
+    def insert_attester_slashing(self, state, slashing) -> bool:
+        targets = (set(slashing.attestation_1.attesting_indices)
+                   & set(slashing.attestation_2.attesting_indices))
+        epoch = get_current_epoch(state)
+        slashable = {i for i in targets
+                     if i < len(state.validators)
+                     and is_slashable_validator(state.validators[i],
+                                                epoch)}
+        with self._lock:
+            if not slashable - self._attester_covered:
+                return False    # no new validator would be slashed
+            self._attester.append(slashing)
+            self._attester_covered |= slashable
+            return True
+
+    def pending_attester_slashings(self, limit: int | None = None):
+        with self._lock:
+            out = list(self._attester)
+        return out[:limit] if limit is not None else out
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def mark_included(self, state) -> None:
+        """Drop ops whose targets are no longer slashable (post-block
+        cleanup)."""
+        epoch = get_current_epoch(state)
+        with self._lock:
+            self._proposer = {
+                i: op for i, op in self._proposer.items()
+                if i < len(state.validators)
+                and is_slashable_validator(state.validators[i], epoch)}
+            kept = []
+            covered: set[int] = set()
+            for op in self._attester:
+                targets = (set(op.attestation_1.attesting_indices)
+                           & set(op.attestation_2.attesting_indices))
+                live = {i for i in targets
+                        if i < len(state.validators)
+                        and is_slashable_validator(
+                            state.validators[i], epoch)}
+                if live - covered:
+                    kept.append(op)
+                    covered |= live
+            self._attester = kept
+            self._attester_covered = covered

@@ -1,0 +1,42 @@
+"""Voluntary-exit pool.
+
+Reference analog: ``beacon-chain/operations/voluntaryexits`` [U,
+SURVEY.md §2]: pending signed exits awaiting inclusion, one per
+validator.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..core.helpers import FAR_FUTURE_EPOCH
+
+
+class VoluntaryExitPool:
+    def __init__(self):
+        self._exits: dict[int, object] = {}   # validator idx -> signed op
+        self._lock = threading.RLock()
+
+    def insert(self, state, signed_exit) -> bool:
+        idx = signed_exit.message.validator_index
+        with self._lock:
+            if idx in self._exits:
+                return False
+            if idx >= len(state.validators):
+                return False
+            if state.validators[idx].exit_epoch != FAR_FUTURE_EPOCH:
+                return False    # already exiting
+            self._exits[idx] = signed_exit
+            return True
+
+    def pending(self, limit: int | None = None):
+        with self._lock:
+            out = list(self._exits.values())
+        return out[:limit] if limit is not None else out
+
+    def mark_included(self, state) -> None:
+        with self._lock:
+            self._exits = {
+                i: op for i, op in self._exits.items()
+                if i < len(state.validators)
+                and state.validators[i].exit_epoch == FAR_FUTURE_EPOCH}

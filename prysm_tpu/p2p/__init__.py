@@ -1,0 +1,15 @@
+"""P2P layer: topic-based gossip + req/resp between in-process nodes.
+
+Reference analog: ``beacon-chain/p2p`` (libp2p gossipsub + snappy-SSZ
+req/resp) and ``beacon-chain/p2p/testing.TestP2P`` (mocknet fake) [U,
+SURVEY.md §2 "p2p", §4 "Mocks"].  Real networking stays host-side and
+out of the TPU scope (SURVEY §5 "Distributed communication backend");
+the in-process bus reproduces gossipsub's delivery semantics for
+multi-node tests and the node harness.
+"""
+
+from .bus import GossipBus, Peer, TOPIC_BLOCK, TOPIC_ATTESTATION, \
+    TOPIC_AGGREGATE, TOPIC_EXIT, TOPIC_SLASHING
+
+__all__ = ["GossipBus", "Peer", "TOPIC_BLOCK", "TOPIC_ATTESTATION",
+           "TOPIC_AGGREGATE", "TOPIC_EXIT", "TOPIC_SLASHING"]

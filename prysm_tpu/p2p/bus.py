@@ -1,0 +1,144 @@
+"""In-process gossip bus with gossipsub-like semantics.
+
+Reference analog: ``p2p/testing.TestP2P`` over libp2p mocknet [U,
+SURVEY.md §4 "Mocks"]: peers join topics, ``broadcast`` delivers the
+SSZ-encoded message to every *other* subscribed peer's validator
+callback, and a validator verdict of ACCEPT forwards / REJECT drops —
+matching gossipsub topic-validation flow.  Req/resp (block-by-range)
+runs as a direct peer call with the same request/response shapes as
+the reference's snappy-SSZ RPC.
+
+Wire format: messages cross the bus as *bytes* (SSZ), never as shared
+Python objects — each node deserializes its own copy, so tests
+exercise the same codec path a real network would.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from enum import Enum
+from typing import Callable
+
+TOPIC_BLOCK = "beacon_block"
+TOPIC_ATTESTATION = "beacon_attestation"
+TOPIC_AGGREGATE = "beacon_aggregate_and_proof"
+TOPIC_EXIT = "voluntary_exit"
+TOPIC_SLASHING = "attester_slashing"
+
+
+class Verdict(Enum):
+    ACCEPT = "accept"
+    IGNORE = "ignore"
+    REJECT = "reject"
+
+
+class Peer:
+    """One node's handle on the bus."""
+
+    def __init__(self, bus: "GossipBus", peer_id: str):
+        self.bus = bus
+        self.peer_id = peer_id
+        # topic -> validator+handler
+        self.handlers: dict[str, Callable[[str, bytes], Verdict]] = {}
+        self.rpc_handlers: dict[str, Callable] = {}
+        self.score: float = 0.0
+
+    def subscribe(self, topic: str,
+                  handler: Callable[[str, bytes], Verdict]) -> None:
+        """handler(from_peer, data) -> Verdict; runs validation AND
+        processing (the reference splits these; the fake keeps the
+        verdict contract so scoring/forwarding semantics match)."""
+        self.handlers[topic] = handler
+        self.bus._subscribe(topic, self)
+
+    def unsubscribe(self, topic: str) -> None:
+        self.handlers.pop(topic, None)
+        self.bus._unsubscribe(topic, self)
+
+    def broadcast(self, topic: str, data: bytes) -> dict[str, Verdict]:
+        return self.bus.broadcast(self.peer_id, topic, data)
+
+    def register_rpc(self, method: str, fn: Callable) -> None:
+        """fn(request) -> response (BeaconBlocksByRange analog)."""
+        self.rpc_handlers[method] = fn
+
+    def request(self, peer_id: str, method: str, payload):
+        return self.bus.request(peer_id, method, payload)
+
+    def peers(self) -> list[str]:
+        return [p for p in self.bus.peer_ids() if p != self.peer_id]
+
+
+class GossipBus:
+    """The shared medium connecting in-process peers."""
+
+    def __init__(self):
+        self._peers: dict[str, Peer] = {}
+        self._topics: dict[str, list[Peer]] = defaultdict(list)
+        self._lock = threading.RLock()
+        self.delivered: int = 0
+        self.rejected: int = 0
+
+    def join(self, peer_id: str) -> Peer:
+        with self._lock:
+            if peer_id in self._peers:
+                raise ValueError(f"duplicate peer id {peer_id!r}")
+            peer = Peer(self, peer_id)
+            self._peers[peer_id] = peer
+            return peer
+
+    def leave(self, peer_id: str) -> None:
+        with self._lock:
+            peer = self._peers.pop(peer_id, None)
+            if peer:
+                for subs in self._topics.values():
+                    if peer in subs:
+                        subs.remove(peer)
+
+    def peer_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._peers)
+
+    def _subscribe(self, topic: str, peer: Peer) -> None:
+        with self._lock:
+            if peer not in self._topics[topic]:
+                self._topics[topic].append(peer)
+
+    def _unsubscribe(self, topic: str, peer: Peer) -> None:
+        with self._lock:
+            if peer in self._topics[topic]:
+                self._topics[topic].remove(peer)
+
+    def broadcast(self, from_peer: str, topic: str, data: bytes
+                  ) -> dict[str, Verdict]:
+        """Deliver to every other subscriber; returns each peer's
+        verdict.  REJECT decrements the sender's score (gossipsub
+        peer-scoring analog)."""
+        with self._lock:
+            targets = [p for p in self._topics.get(topic, [])
+                       if p.peer_id != from_peer]
+            sender = self._peers.get(from_peer)
+        verdicts: dict[str, Verdict] = {}
+        for peer in targets:
+            handler = peer.handlers.get(topic)
+            if handler is None:
+                continue
+            verdict = handler(from_peer, bytes(data))
+            verdicts[peer.peer_id] = verdict
+            self.delivered += 1
+            if verdict == Verdict.REJECT:
+                self.rejected += 1
+                if sender is not None:
+                    sender.score -= 1.0
+        return verdicts
+
+    def request(self, peer_id: str, method: str, payload):
+        with self._lock:
+            peer = self._peers.get(peer_id)
+        if peer is None:
+            raise KeyError(f"unknown peer {peer_id!r}")
+        fn = peer.rpc_handlers.get(method)
+        if fn is None:
+            raise KeyError(f"peer {peer_id!r} has no handler {method!r}")
+        return fn(payload)

@@ -1,0 +1,11 @@
+"""Runtime utilities: service registry, slot ticker.
+
+Reference analog: ``runtime/`` (service registry), ``time/slots``
+(slot ticker/clock) [U, SURVEY.md §2 "runtime/async/io/etc."].
+"""
+
+from .registry import Service, ServiceRegistry
+from .ticker import SlotTicker, slot_at, slot_start_time
+
+__all__ = ["Service", "ServiceRegistry", "SlotTicker", "slot_at",
+           "slot_start_time"]

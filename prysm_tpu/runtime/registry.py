@@ -1,0 +1,68 @@
+"""Dependency-ordered service registry.
+
+Reference analog: ``beacon-chain/node`` + ``runtime`` registry
+(RegisterService, StartAll in dependency order, StopAll reversed,
+Status surfacing) [U, SURVEY.md §2 "node assembly", §3.1].
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Service(Protocol):
+    def start(self) -> None: ...
+    def stop(self) -> None: ...
+
+
+class ServiceRegistry:
+    def __init__(self):
+        self._order: list[str] = []
+        self._services: dict[str, object] = {}
+        self.started = False
+
+    def register(self, name: str, service) -> None:
+        if name in self._services:
+            raise ValueError(f"service {name!r} already registered")
+        if not (hasattr(service, "start") and hasattr(service, "stop")):
+            raise TypeError(f"service {name!r} lacks start/stop")
+        self._services[name] = service
+        self._order.append(name)
+
+    def get(self, name: str):
+        return self._services[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._services
+
+    def start_all(self) -> None:
+        """Registration order IS dependency order (reference
+        contract)."""
+        for name in self._order:
+            self._services[name].start()
+        self.started = True
+
+    def stop_all(self) -> None:
+        for name in reversed(self._order):
+            try:
+                self._services[name].stop()
+            except Exception:
+                pass   # best-effort shutdown, matching the reference
+        self.started = False
+
+    def statuses(self) -> dict[str, str | None]:
+        """name -> None if healthy else an error string."""
+        out: dict[str, str | None] = {}
+        for name in self._order:
+            svc = self._services[name]
+            status = getattr(svc, "status", None)
+            if callable(status):
+                try:
+                    err = status()
+                    out[name] = None if err is None else str(err)
+                except Exception as e:  # status itself failing is an error
+                    out[name] = repr(e)
+            else:
+                out[name] = None
+        return out

@@ -1,0 +1,87 @@
+"""Slot clock + ticker.
+
+Reference analog: ``time/slots.Ticker`` [U, SURVEY.md §2
+"runtime/async/io/etc."]: fires a callback at each slot start, driven
+by genesis time + seconds_per_slot.  A ``time_fn`` hook lets tests and
+the in-process e2e harness drive time synthetically (epochs of
+seconds, as the reference's minimal-config e2e does).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from ..config import beacon_config
+
+
+def slot_at(genesis_time: float, now: float, cfg=None) -> int:
+    cfg = cfg or beacon_config()
+    if now < genesis_time:
+        return 0
+    return int(now - genesis_time) // cfg.seconds_per_slot
+
+
+def slot_start_time(genesis_time: float, slot: int, cfg=None) -> float:
+    cfg = cfg or beacon_config()
+    return genesis_time + slot * cfg.seconds_per_slot
+
+
+class SlotTicker:
+    """Calls ``on_slot(slot)`` at each slot boundary in a daemon
+    thread.  ``tick_once`` drives it synchronously for tests."""
+
+    def __init__(self, genesis_time: float,
+                 on_slot: Callable[[int], None],
+                 time_fn: Callable[[], float] = time.time):
+        self.genesis_time = genesis_time
+        self.on_slot = on_slot
+        self.time_fn = time_fn
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.last_slot = -1
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def tick_once(self) -> int | None:
+        """Fire the callback if a new slot started; returns the slot
+        fired or None."""
+        now = self.time_fn()
+        slot = slot_at(self.genesis_time, now)
+        if now >= self.genesis_time and slot > self.last_slot:
+            self.last_slot = slot
+            self.on_slot(slot)
+            return slot
+        return None
+
+    def _run(self) -> None:
+        cfg = beacon_config()
+        while not self._stop.is_set():
+            try:
+                self.tick_once()
+            except Exception:
+                # a failing slot callback must not kill the clock;
+                # the next boundary retries (callback owns its errors)
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "slot callback failed")
+            # sleep to just past the next boundary
+            now = self.time_fn()
+            if now < self.genesis_time:
+                wait = min(self.genesis_time - now, 1.0)
+            else:
+                nxt = slot_start_time(self.genesis_time,
+                                      slot_at(self.genesis_time, now) + 1)
+                wait = min(max(nxt - now, 0.01), cfg.seconds_per_slot)
+            self._stop.wait(wait)

@@ -1,0 +1,10 @@
+"""Sync service: gossip validation + initial sync.
+
+Reference analog: ``beacon-chain/sync`` (+ ``initial-sync``) [U,
+SURVEY.md §2 "sync svc", §3.3, §3.5].
+"""
+
+from .service import SyncService
+from .initial import initial_sync
+
+__all__ = ["SyncService", "initial_sync"]

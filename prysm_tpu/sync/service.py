@@ -1,0 +1,194 @@
+"""Gossip topic validators + handlers.
+
+Reference analog: ``beacon-chain/sync`` [U, SURVEY.md §2, §3.3]:
+``validateBeaconBlockPubSub`` (cheap checks + proposer signature, then
+hand to blockchain), ``validateCommitteeIndexBeaconAttestation``
+(committee checks; signature deferred to the pool's slot batch — the
+north-star change: accumulate, then ONE device dispatch per slot),
+pending-block queue for out-of-order arrival, and the
+BeaconBlocksByRange req/resp handler.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..blockchain import BlockchainService, BlockProcessingError
+from ..config import beacon_config
+from ..core.helpers import (
+    compute_epoch_at_slot, get_beacon_committee,
+    get_committee_count_per_slot,
+)
+from ..operations import AttestationPool
+from ..p2p.bus import (
+    Peer, TOPIC_ATTESTATION, TOPIC_BLOCK, Verdict,
+)
+from ..proto import Attestation, active_types
+
+RPC_BLOCKS_BY_RANGE = "beacon_blocks_by_range"
+
+
+class SyncService:
+    def __init__(self, peer: Peer, chain: BlockchainService,
+                 att_pool: AttestationPool, types=None, metrics=None):
+        self.peer = peer
+        self.chain = chain
+        self.att_pool = att_pool
+        self.types = types or chain.types
+        self.metrics = metrics
+        # parent root -> [queued children] (multiple forks may share a
+        # missing parent)
+        self.pending_blocks: dict[bytes, list] = {}
+        self._lock = threading.RLock()
+        self.seen_block_roots: set[bytes] = set()
+        self.seen_attestations: set[bytes] = set()
+
+    def start(self) -> None:
+        self.peer.subscribe(TOPIC_BLOCK, self.on_block_gossip)
+        self.peer.subscribe(TOPIC_ATTESTATION, self.on_attestation_gossip)
+        self.peer.register_rpc(RPC_BLOCKS_BY_RANGE,
+                               self.handle_blocks_by_range)
+
+    def stop(self) -> None:
+        self.peer.unsubscribe(TOPIC_BLOCK)
+        self.peer.unsubscribe(TOPIC_ATTESTATION)
+
+    # --- gossip: blocks ----------------------------------------------------
+
+    def on_block_gossip(self, from_peer: str, data: bytes) -> Verdict:
+        """validateBeaconBlockPubSub analog: decode, cheap checks,
+        full receive."""
+        try:
+            signed = self.types.SignedBeaconBlock.deserialize(data)
+        except Exception:
+            return Verdict.REJECT
+        block = signed.message
+        root = type(block).hash_tree_root(block)
+        with self._lock:
+            if root in self.seen_block_roots:
+                return Verdict.IGNORE
+        if block.slot > 0 and not (
+                self.chain.db.has_block(block.parent_root)
+                or block.parent_root == self.chain.genesis_root):
+            # parent unknown: queue for later; NOT marked seen, so a
+            # re-gossip after the parent arrives can still connect it
+            with self._lock:
+                queue = self.pending_blocks.setdefault(
+                    block.parent_root, [])
+                if not any(
+                        type(q.message).hash_tree_root(q.message) == root
+                        for q in queue):
+                    queue.append(signed)
+            return Verdict.IGNORE
+        return self._receive_and_unqueue(signed, root)
+
+    def _receive_and_unqueue(self, signed, root: bytes) -> Verdict:
+        try:
+            self.chain.receive_block(signed)
+        except BlockProcessingError:
+            with self._lock:
+                self.seen_block_roots.add(root)   # invalid: never retry
+            return Verdict.REJECT
+        with self._lock:
+            self.seen_block_roots.add(root)
+        # queued children (possibly several forks) may now connect
+        self._receive_and_unqueue_children(root)
+        return Verdict.ACCEPT
+
+    def retry_pending(self) -> None:
+        """Connect any queued block whose parent has arrived through a
+        non-gossip path (initial sync, direct receive) — called from
+        the slot tick."""
+        with self._lock:
+            ready = [p for p in self.pending_blocks
+                     if self.chain.db.has_block(p)
+                     or p == self.chain.genesis_root]
+        for parent in ready:
+            self._receive_and_unqueue_children(parent)
+
+    def _receive_and_unqueue_children(self, parent: bytes) -> None:
+        frontier = [parent]
+        while frontier:
+            p = frontier.pop()
+            with self._lock:
+                children = self.pending_blocks.pop(p, [])
+            for child in children:
+                child_root = type(child.message).hash_tree_root(
+                    child.message)
+                try:
+                    self.chain.receive_block(child)
+                except BlockProcessingError:
+                    continue
+                with self._lock:
+                    self.seen_block_roots.add(child_root)
+                frontier.append(child_root)
+
+    # --- gossip: attestations ---------------------------------------------
+
+    def on_attestation_gossip(self, from_peer: str, data: bytes
+                              ) -> Verdict:
+        """validateCommitteeIndexBeaconAttestation analog.  Structural
+        + committee checks here; the BLS check is DEFERRED to the
+        pool's whole-slot batch (north-star §3.3)."""
+        try:
+            att = Attestation.deserialize(data)
+        except Exception:
+            return Verdict.REJECT
+        key = Attestation.hash_tree_root(att)
+        with self._lock:
+            if key in self.seen_attestations:
+                return Verdict.IGNORE
+            self.seen_attestations.add(key)
+
+        state = self.chain.head_state
+        epoch = compute_epoch_at_slot(att.data.slot)
+        if att.data.target.epoch != epoch:
+            return Verdict.REJECT
+        try:
+            count = get_committee_count_per_slot(state,
+                                                 att.data.target.epoch)
+            if att.data.index >= count:
+                return Verdict.REJECT
+            committee = get_beacon_committee(state, att.data.slot,
+                                             att.data.index)
+        except Exception:
+            return Verdict.IGNORE
+        if len(att.aggregation_bits) != len(committee):
+            return Verdict.REJECT
+        n_bits = sum(att.aggregation_bits)
+        if n_bits == 0:
+            return Verdict.REJECT
+        if n_bits == 1:
+            self.att_pool.save_unaggregated(att)
+        else:
+            self.att_pool.save_aggregated(att)
+        # votes count after batch verification (see verify_slot_batch)
+        return Verdict.ACCEPT
+
+    def verify_slot_batch(self, slot: int) -> bool:
+        """The per-slot device dispatch: verify every pooled
+        attestation of ``slot`` in one RLC batch; on success, feed
+        fork-choice votes."""
+        state = self.chain.head_state
+        batch = self.att_pool.build_slot_signature_batch(state, slot)
+        if len(batch) == 0:
+            return True
+        ok = batch.verify()
+        if self.metrics is not None:
+            self.metrics.inc("slot_batch_signatures", len(batch))
+        if ok:
+            for _, g in self.att_pool.groups_for_slot(slot).items():
+                for att in g.aggregated + g.unaggregated:
+                    self.chain.process_attestation_votes(state, att)
+        return ok
+
+    # --- req/resp ----------------------------------------------------------
+
+    def handle_blocks_by_range(self, payload):
+        """BeaconBlocksByRange analog: {start_slot, count} -> SSZ
+        block bytes, slot order."""
+        start = int(payload["start_slot"])
+        count = int(payload["count"])
+        blocks = self.chain.db.blocks_by_range(start, start + count)
+        sbt = self.types.SignedBeaconBlock
+        return [sbt.serialize(b) for b in blocks]

@@ -194,13 +194,6 @@ def generate_full_block(state, slot: int | None = None,
 
 
 def _header_root_with_state(state) -> bytes:
-    header = BeaconBlockHeader(
-        slot=state.latest_block_header.slot,
-        proposer_index=state.latest_block_header.proposer_index,
-        parent_root=state.latest_block_header.parent_root,
-        state_root=state.latest_block_header.state_root,
-        body_root=state.latest_block_header.body_root,
-    )
-    if header.state_root == b"\x00" * 32:
-        header.state_root = type(state).hash_tree_root(state)
-    return header.root()
+    from ..core.helpers import latest_header_root
+
+    return latest_header_root(state)

@@ -1,0 +1,220 @@
+"""Operation-pool tests: attestation pool/aggregator + slot batch,
+slashing + exit pools."""
+
+import pytest
+
+from prysm_tpu.config import use_mainnet_config, use_minimal_config
+from prysm_tpu.crypto.bls import bls
+from prysm_tpu.operations import (
+    AttestationPool, SlashingPool, VoluntaryExitPool,
+)
+from prysm_tpu.operations.attestations import AttestationPoolError
+from prysm_tpu.proto import Attestation, build_types
+from prysm_tpu.testing import util as testutil
+
+
+@pytest.fixture(scope="module")
+def env():
+    use_minimal_config()
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    types = build_types(MINIMAL_CONFIG)
+    genesis = testutil.deterministic_genesis_state(16, types)
+    from prysm_tpu.core.transition import process_slots
+
+    st = genesis.copy()
+    process_slots(st, 2, types)
+    yield types, st
+    use_mainnet_config()
+
+
+def single_bit_atts(state, slot, index):
+    """One single-signer attestation per committee member."""
+    from prysm_tpu.core.helpers import get_beacon_committee
+
+    committee = get_beacon_committee(state, slot, index)
+    atts = []
+    for pos in range(len(committee)):
+        bits = [p == pos for p in range(len(committee))]
+        atts.append(testutil.valid_attestation(state, slot, index,
+                                               bits=bits))
+    return atts, committee
+
+
+class TestAttestationPool:
+    def test_unaggregated_requires_single_bit(self, env):
+        types, st = env
+        pool = AttestationPool()
+        att = testutil.valid_attestation(st, 1, 0)   # all bits set
+        with pytest.raises(AttestationPoolError):
+            pool.save_unaggregated(att)
+
+    def test_aggregator_merges_to_full_committee(self, env):
+        types, st = env
+        pool = AttestationPool()
+        atts, committee = single_bit_atts(st, 1, 0)
+        for a in atts:
+            pool.save_unaggregated(a)
+        assert pool.unaggregated_count() == len(committee)
+        pool.aggregate_unaggregated()
+        assert pool.unaggregated_count() == 0
+        aggs = pool.aggregated_for_block(slot=1)
+        assert len(aggs) == 1
+        agg = aggs[0]
+        assert all(agg.aggregation_bits)
+        # merged signature must equal the full-committee aggregate
+        full = testutil.valid_attestation(st, 1, 0)
+        assert agg.signature == full.signature
+
+    def test_subset_aggregate_dropped(self, env):
+        types, st = env
+        full = testutil.valid_attestation(st, 1, 0)
+        pool = AttestationPool()
+        pool.save_aggregated(full)
+        # a 2-bit subset brings nothing new
+        bits = [i < 2 for i in range(len(full.aggregation_bits))]
+        sub = testutil.valid_attestation(st, 1, 0, bits=bits)
+        pool.save_aggregated(sub)
+        assert pool.aggregated_count() == 1
+        # and a superset replaces a subset
+        pool2 = AttestationPool()
+        pool2.save_aggregated(sub)
+        pool2.save_aggregated(full)
+        assert pool2.aggregated_count() == 1
+        assert all(pool2.aggregated_for_block(slot=1)[0].aggregation_bits)
+
+    def test_aggregator_drops_covered_singles(self, env):
+        """A single-bit attestation already covered by an aggregate
+        must not become a redundant standalone aggregate."""
+        types, st = env
+        pool = AttestationPool()
+        full = testutil.valid_attestation(st, 1, 0)
+        pool.save_aggregated(full)
+        atts, _ = single_bit_atts(st, 1, 0)
+        pool.save_unaggregated(atts[0])
+        pool.aggregate_unaggregated()
+        assert pool.aggregated_count() == 1
+
+    def test_prune_before(self, env):
+        types, st = env
+        pool = AttestationPool()
+        pool.save_aggregated(testutil.valid_attestation(st, 1, 0))
+        pool.save_aggregated(testutil.valid_attestation(st, 0, 0))
+        pool.prune_before(1)
+        assert len(pool.aggregated_for_block()) == 1
+
+    def test_slot_signature_batch_verifies(self, env):
+        """North-star path: every committee of a slot accumulates into
+        one SignatureBatch; tampering any entry fails the whole
+        batch."""
+        types, st = env
+        from prysm_tpu.core.helpers import get_committee_count_per_slot
+
+        pool = AttestationPool()
+        count = get_committee_count_per_slot(st, 0)
+        for index in range(count):
+            pool.save_aggregated(testutil.valid_attestation(st, 1, index))
+        batch = pool.build_slot_signature_batch(st, 1)
+        assert len(batch) == count
+        assert batch.verify()
+
+    def test_slot_batch_detects_tamper(self, env):
+        types, st = env
+        pool = AttestationPool()
+        att = testutil.valid_attestation(st, 1, 0)
+        # tamper: replace signature with another committee's
+        other = testutil.valid_attestation(st, 1, 1)
+        bad = Attestation(aggregation_bits=att.aggregation_bits,
+                          data=att.data, signature=other.signature)
+        pool.save_aggregated(bad)
+        batch = pool.build_slot_signature_batch(st, 1)
+        assert len(batch) == 1
+        assert not batch.verify()
+
+
+class TestSlashingPools:
+    def _slashing(self, st, types):
+        """A minimal attester slashing: same target epoch, different
+        data (double vote) for committee of slot 1."""
+        from prysm_tpu.core.helpers import (
+            get_beacon_committee, get_domain, compute_signing_root,
+        )
+        from prysm_tpu.config import beacon_config
+        from prysm_tpu.proto import (
+            AttesterSlashing, AttestationData, Checkpoint,
+            IndexedAttestation,
+        )
+
+        cfg = beacon_config()
+        committee = get_beacon_committee(st, 1, 0)
+        d1 = AttestationData(slot=1, index=0,
+                             beacon_block_root=b"\x01" * 32,
+                             source=Checkpoint(epoch=0, root=b"\x00" * 32),
+                             target=Checkpoint(epoch=0, root=b"\x02" * 32))
+        d2 = AttestationData(slot=1, index=0,
+                             beacon_block_root=b"\x03" * 32,
+                             source=Checkpoint(epoch=0, root=b"\x00" * 32),
+                             target=Checkpoint(epoch=0, root=b"\x04" * 32))
+        out = []
+        for d in (d1, d2):
+            domain = get_domain(st, cfg.domain_beacon_attester, 0)
+            root = compute_signing_root(d, domain)
+            sigs = [testutil.secret_key_for(i).sign(root)
+                    for i in committee]
+            out.append(IndexedAttestation(
+                attesting_indices=sorted(committee),
+                data=d,
+                signature=bls.Signature.aggregate(sigs).to_bytes()))
+        return AttesterSlashing(attestation_1=out[0], attestation_2=out[1])
+
+    def test_attester_slashing_dedup(self, env):
+        types, st = env
+        pool = SlashingPool()
+        slashing = self._slashing(st, types)
+        assert pool.insert_attester_slashing(st, slashing)
+        # same validators covered -> rejected
+        assert not pool.insert_attester_slashing(st, slashing)
+        assert len(pool.pending_attester_slashings()) == 1
+
+    def test_proposer_slashing_insert_and_cleanup(self, env):
+        types, st = env
+        from prysm_tpu.proto import (
+            BeaconBlockHeader, ProposerSlashing, SignedBeaconBlockHeader,
+        )
+
+        h1 = SignedBeaconBlockHeader(
+            message=BeaconBlockHeader(slot=1, proposer_index=3,
+                                      parent_root=b"\x01" * 32),
+            signature=b"\x00" * 96)
+        h2 = SignedBeaconBlockHeader(
+            message=BeaconBlockHeader(slot=1, proposer_index=3,
+                                      parent_root=b"\x02" * 32),
+            signature=b"\x00" * 96)
+        op = ProposerSlashing(signed_header_1=h1, signed_header_2=h2)
+        pool = SlashingPool()
+        assert pool.insert_proposer_slashing(st, op)
+        assert not pool.insert_proposer_slashing(st, op)   # dup
+        # after the validator is slashed, cleanup drops it
+        work = st.copy()
+        work.validators[3].slashed = True
+        pool.mark_included(work)
+        assert pool.pending_proposer_slashings() == []
+
+
+class TestExitPool:
+    def test_insert_and_dedup(self, env):
+        types, st = env
+        from prysm_tpu.proto import SignedVoluntaryExit, VoluntaryExit
+
+        op = SignedVoluntaryExit(
+            message=VoluntaryExit(epoch=0, validator_index=5),
+            signature=b"\x00" * 96)
+        pool = VoluntaryExitPool()
+        assert pool.insert(st, op)
+        assert not pool.insert(st, op)
+        assert len(pool.pending()) == 1
+        # exit initiated -> cleaned up
+        work = st.copy()
+        work.validators[5].exit_epoch = 10
+        pool.mark_included(work)
+        assert pool.pending() == []

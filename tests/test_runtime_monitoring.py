@@ -1,0 +1,137 @@
+"""Runtime (registry, ticker) + monitoring (metrics, tracing) tests."""
+
+import pytest
+
+from prysm_tpu.monitoring import MetricsRegistry
+from prysm_tpu.monitoring import tracing
+from prysm_tpu.runtime import ServiceRegistry, SlotTicker, slot_at
+
+
+class _Svc:
+    def __init__(self, log, name, fail_start=False):
+        self.log = log
+        self.name = name
+        self.fail_start = fail_start
+        self._err = None
+
+    def start(self):
+        if self.fail_start:
+            raise RuntimeError("boom")
+        self.log.append(("start", self.name))
+
+    def stop(self):
+        self.log.append(("stop", self.name))
+
+    def status(self):
+        return self._err
+
+
+class TestServiceRegistry:
+    def test_start_order_and_stop_reversed(self):
+        log = []
+        reg = ServiceRegistry()
+        for n in ("db", "chain", "sync"):
+            reg.register(n, _Svc(log, n))
+        reg.start_all()
+        assert log == [("start", "db"), ("start", "chain"),
+                       ("start", "sync")]
+        reg.stop_all()
+        assert log[3:] == [("stop", "sync"), ("stop", "chain"),
+                           ("stop", "db")]
+
+    def test_duplicate_rejected(self):
+        reg = ServiceRegistry()
+        reg.register("a", _Svc([], "a"))
+        with pytest.raises(ValueError):
+            reg.register("a", _Svc([], "a"))
+
+    def test_statuses(self):
+        reg = ServiceRegistry()
+        s = _Svc([], "a")
+        reg.register("a", s)
+        assert reg.statuses() == {"a": None}
+        s._err = "degraded"
+        assert reg.statuses() == {"a": "degraded"}
+
+
+class TestSlotTicker:
+    def test_synthetic_time_ticks(self):
+        fired = []
+        now = [1000.0]
+        t = SlotTicker(genesis_time=1000.0, on_slot=fired.append,
+                       time_fn=lambda: now[0])
+        assert t.tick_once() == 0
+        assert t.tick_once() is None        # same slot: no refire
+        now[0] += 12.0                      # mainnet seconds_per_slot
+        assert t.tick_once() == 1
+        now[0] += 36.0
+        assert t.tick_once() == 4           # skipped slots jump
+        assert fired == [0, 1, 4]
+
+    def test_before_genesis_no_fire(self):
+        fired = []
+        t = SlotTicker(genesis_time=2000.0, on_slot=fired.append,
+                       time_fn=lambda: 1500.0)
+        assert t.tick_once() is None
+        assert fired == []
+
+    def test_slot_at(self):
+        assert slot_at(100.0, 99.0) == 0
+        assert slot_at(100.0, 100.0) == 0
+        assert slot_at(100.0, 124.0) == 2
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        m = MetricsRegistry()
+        m.inc("reqs")
+        m.inc("reqs", 2)
+        m.set("head_slot", 7)
+        for v in (0.001, 0.002, 0.003, 0.1):
+            m.observe("latency_seconds", v)
+        assert m.counter("reqs").value == 3
+        assert m.gauge("head_slot").value == 7
+        h = m.histogram("latency_seconds")
+        assert h.n == 4
+        assert 0.001 <= h.p50() <= 0.003
+
+    def test_type_conflict_raises(self):
+        m = MetricsRegistry()
+        m.counter("x")
+        with pytest.raises(TypeError):
+            m.gauge("x")
+
+    def test_render_exposition(self):
+        m = MetricsRegistry()
+        m.inc("blocks_processed")
+        m.observe("lat", 0.5)
+        text = m.render()
+        assert "# TYPE blocks_processed counter" in text
+        assert 'lat_bucket{le="+Inf"} 1' in text
+        assert "lat_count 1" in text
+
+
+class TestTracing:
+    def test_span_nesting_recorded(self):
+        tracing.enable_tracing(True)
+        tracing.clear()
+        try:
+            with tracing.span("blockchain.on_block", slot=3):
+                with tracing.span("transition"):
+                    pass
+            recs = tracing.records()
+            names = [r["span"] for r in recs]
+            assert "blockchain.on_block.transition" in names
+            assert "blockchain.on_block" in names
+            outer = next(r for r in recs
+                         if r["span"] == "blockchain.on_block")
+            assert outer["slot"] == 3
+        finally:
+            tracing.enable_tracing(False)
+
+    def test_disabled_spans_free(self):
+        tracing.enable_tracing(False)
+        tracing.clear()
+        with tracing.span("x"):
+            pass
+        assert tracing.records() == []
