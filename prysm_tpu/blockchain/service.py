@@ -19,10 +19,7 @@ from __future__ import annotations
 
 import time
 
-from ..config import beacon_config
-from ..core.helpers import (
-    compute_epoch_at_slot, get_attesting_indices,
-)
+from ..core.helpers import get_attesting_indices
 from ..core.transition import (
     StateTransitionError, collect_block_signature_batch,
     state_transition,
@@ -83,15 +80,18 @@ class BlockchainService:
             raise BlockProcessingError(
                 f"unknown parent {parent_root.hex()[:16]}") from e
 
-        # 1. whole-block signature batch: ONE device dispatch
+        # 1. whole-block signature batch: ONE device dispatch.
+        # pre_state is already our own copy (stategen returns copies),
+        # so the slot advancement here is reused by the transition
+        # below — epoch processing runs once, not twice.
         if verify_signatures:
-            work = pre_state.copy()
-            if work.slot < block.slot:
-                from ..core.transition import process_slots
-
-                process_slots(work, block.slot, self.types)
             try:
-                batch = collect_block_signature_batch(work, signed_block)
+                if pre_state.slot < block.slot:
+                    from ..core.transition import process_slots
+
+                    process_slots(pre_state, block.slot, self.types)
+                batch = collect_block_signature_batch(pre_state,
+                                                      signed_block)
             except (ValueError, StateTransitionError) as e:
                 # malformed signature/pubkey bytes or bad structure
                 raise BlockProcessingError(
@@ -152,9 +152,19 @@ class BlockchainService:
             self.forkchoice.update_justified(
                 self.justified_checkpoint.epoch,
                 self.finalized_checkpoint.epoch)
-            # refresh vote weights from the justified state's balances
-            self.forkchoice.set_balances(
-                [v.effective_balance for v in post.validators])
+            # refresh vote weights from the JUSTIFIED state's balances
+            # (spec get_weight uses the justified checkpoint state,
+            # not whichever block triggered the update)
+            balances = None
+            try:
+                jstate = self.stategen.state_by_root(
+                    self.justified_checkpoint.root)
+                balances = [v.effective_balance
+                            for v in jstate.validators]
+            except Exception:
+                balances = [v.effective_balance
+                            for v in post.validators]
+            self.forkchoice.set_balances(balances)
         if (post.finalized_checkpoint.epoch
                 > self.finalized_checkpoint.epoch):
             self.finalized_checkpoint = post.finalized_checkpoint
@@ -191,8 +201,6 @@ class BlockchainService:
         return self.head_state.slot
 
     def current_slot_at(self, unix_time: float) -> int:
-        cfg = beacon_config()
-        genesis_time = self.head_state.genesis_time
-        if unix_time < genesis_time:
-            return 0
-        return int(unix_time - genesis_time) // cfg.seconds_per_slot
+        from ..runtime.ticker import slot_at
+
+        return slot_at(self.head_state.genesis_time, unix_time)

@@ -113,14 +113,21 @@ class AttestationPool:
                                         agg.aggregation_bits)
                            for agg in g.aggregated):
                         continue   # already covered: drop, don't dup
+                    try:
+                        att_sig = bls.Signature.from_bytes(att.signature)
+                    except ValueError:
+                        continue   # malformed single: drop
                     merged = False
                     for i, agg in enumerate(g.aggregated):
                         if _bits_overlap(att.aggregation_bits,
                                          agg.aggregation_bits):
                             continue
-                        sig = bls.Signature.aggregate([
-                            bls.Signature.from_bytes(agg.signature),
-                            bls.Signature.from_bytes(att.signature)])
+                        try:
+                            agg_sig = bls.Signature.from_bytes(
+                                agg.signature)
+                        except ValueError:
+                            continue   # don't merge into bad aggregate
+                        sig = bls.Signature.aggregate([agg_sig, att_sig])
                         g.aggregated[i] = Attestation(
                             aggregation_bits=_merge_bits(
                                 agg.aggregation_bits,

@@ -1,0 +1,10 @@
+"""RPC/API layer.
+
+Reference analog: ``beacon-chain/rpc`` (gRPC prysm/v1alpha1 validator
+service + Eth Beacon REST gateway) [U, SURVEY.md §2 "RPC"].
+"""
+
+from .api import ValidatorAPI, APIError
+from .http_server import BeaconHTTPServer
+
+__all__ = ["ValidatorAPI", "APIError", "BeaconHTTPServer"]

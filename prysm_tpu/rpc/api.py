@@ -1,0 +1,204 @@
+"""Validator-facing API: duties, block production, submissions.
+
+Reference analog: ``beacon-chain/rpc/prysm/v1alpha1`` validator
+service (GetDuties, GetBeaconBlock, ProposeBeaconBlock,
+GetAttestationData, ProposeAttestation, SubmitAggregateAndProof) [U,
+SURVEY.md §2 "RPC", §3.4].  In-process call surface; the HTTP server
+wraps it for the REST parity layer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..config import beacon_config
+from ..core.helpers import (
+    compute_epoch_at_slot, compute_start_slot_at_epoch,
+    get_beacon_committee, get_beacon_proposer_index,
+    get_committee_count_per_slot,
+)
+from ..core.transition import process_slots, state_transition
+from ..proto import (
+    Attestation, AttestationData, Checkpoint, Eth1Data,
+)
+
+
+class APIError(Exception):
+    pass
+
+
+@dataclass
+class Duty:
+    pubkey: bytes
+    validator_index: int
+    committee: list[int]
+    committee_index: int
+    attester_slot: int
+    proposer_slots: list[int] = field(default_factory=list)
+
+
+class ValidatorAPI:
+    """Wraps one node's services with the validator-client surface."""
+
+    def __init__(self, node):
+        self.node = node
+
+    # --- duties ------------------------------------------------------------
+
+    def get_duties(self, epoch: int, pubkeys: list[bytes]) -> list[Duty]:
+        """GetDuties analog: committee assignment + proposer slots for
+        the epoch, computed on a state advanced to the epoch start."""
+        chain = self.node.chain
+        cfg = beacon_config()
+        start = compute_start_slot_at_epoch(epoch)
+        # anchor at the chain's block at/before the epoch start so the
+        # per-slot proposer walk below never needs to rewind (proposer
+        # seeds depend on the exact slot)
+        anchor = chain.forkchoice.ancestor_at_slot(chain.head_root,
+                                                   start)
+        state = chain.stategen.state_by_root(
+            anchor if anchor is not None else chain.head_root)
+        if state.slot < start:
+            process_slots(state, start, self.node.types)
+
+        index_by_pk = {v.pubkey: i
+                       for i, v in enumerate(state.validators)}
+        duties: dict[int, Duty] = {}
+        wanted = {pk: index_by_pk.get(pk) for pk in pubkeys}
+        count = get_committee_count_per_slot(state, epoch)
+        for slot in range(start, start + cfg.slots_per_epoch):
+            for ci in range(count):
+                committee = get_beacon_committee(state, slot, ci)
+                for pk, vi in wanted.items():
+                    if vi in committee:
+                        duties[vi] = Duty(
+                            pubkey=pk, validator_index=vi,
+                            committee=committee, committee_index=ci,
+                            attester_slot=slot)
+        # proposer slots need per-slot state advancement
+        work = state.copy()
+        for slot in range(max(start, 1), start + cfg.slots_per_epoch):
+            if work.slot < slot:
+                process_slots(work, slot, self.node.types)
+            proposer = get_beacon_proposer_index(work)
+            for pk, vi in wanted.items():
+                if vi == proposer and vi in duties:
+                    duties[vi].proposer_slots.append(slot)
+                elif vi == proposer:
+                    duties[vi] = Duty(pubkey=pk, validator_index=vi,
+                                      committee=[], committee_index=0,
+                                      attester_slot=-1,
+                                      proposer_slots=[slot])
+        return list(duties.values())
+
+    # --- block production --------------------------------------------------
+
+    def get_block_proposal(self, slot: int, randao_reveal: bytes,
+                           graffiti: bytes = b"\x00" * 32):
+        """GetBeaconBlock analog: assemble an unsigned block from the
+        head state + operation pools."""
+        chain = self.node.chain
+        types = self.node.types
+        if slot <= chain.head_slot():
+            raise APIError(f"slot {slot} not after head "
+                           f"{chain.head_slot()}")
+        pre = chain.stategen.state_by_root(chain.head_root)
+        work = pre.copy()
+        process_slots(work, slot, types)
+
+        cfg = beacon_config()
+        att_slot = slot - cfg.min_attestation_inclusion_delay
+        atts = [a for a in self.node.att_pool.aggregated_for_block(
+            slot=att_slot) if a.data.slot + cfg.slots_per_epoch >= slot]
+
+        body = types.BeaconBlockBody(
+            randao_reveal=randao_reveal,
+            eth1_data=Eth1Data(
+                deposit_root=work.eth1_data.deposit_root,
+                deposit_count=work.eth1_data.deposit_count,
+                block_hash=work.eth1_data.block_hash),
+            graffiti=graffiti,
+            attestations=atts,
+            proposer_slashings=self.node.slashing_pool
+                .pending_proposer_slashings(cfg.max_proposer_slashings),
+            attester_slashings=self.node.slashing_pool
+                .pending_attester_slashings(cfg.max_attester_slashings),
+            voluntary_exits=self.node.exit_pool
+                .pending(cfg.max_voluntary_exits),
+        )
+        block = types.BeaconBlock(
+            slot=slot,
+            proposer_index=get_beacon_proposer_index(work),
+            parent_root=chain.head_root,
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        # state root with signatures unverified (proposer signs after)
+        scratch = pre.copy()
+        unsigned = types.SignedBeaconBlock(message=block,
+                                           signature=b"\x00" * 96)
+        state_transition(scratch, unsigned, types,
+                         validate_result=False, verify_signatures=False)
+        block.state_root = types.BeaconState.hash_tree_root(scratch)
+        return block
+
+    def submit_block(self, signed_block) -> bytes:
+        """ProposeBeaconBlock analog: full verification + broadcast."""
+        from ..p2p.bus import TOPIC_BLOCK
+
+        root = self.node.chain.receive_block(signed_block)
+        self.node.peer.broadcast(
+            TOPIC_BLOCK,
+            self.node.types.SignedBeaconBlock.serialize(signed_block))
+        return root
+
+    # --- attestations ------------------------------------------------------
+
+    def get_attestation_data(self, slot: int, committee_index: int
+                             ) -> AttestationData:
+        """GetAttestationData analog, from the head state."""
+        chain = self.node.chain
+        state = chain.head_state
+        if state.slot < slot:
+            state = state.copy()
+            process_slots(state, slot, self.node.types)
+        epoch = compute_epoch_at_slot(slot)
+        epoch_start = compute_start_slot_at_epoch(epoch)
+        if epoch_start < state.slot:
+            from ..core.helpers import get_block_root_at_slot
+
+            target_root = get_block_root_at_slot(state, epoch_start)
+        else:
+            target_root = chain.head_root
+        return AttestationData(
+            slot=slot, index=committee_index,
+            beacon_block_root=chain.head_root,
+            source=Checkpoint(
+                epoch=state.current_justified_checkpoint.epoch,
+                root=state.current_justified_checkpoint.root),
+            target=Checkpoint(epoch=epoch, root=target_root),
+        )
+
+    def submit_attestation(self, att: Attestation) -> None:
+        """ProposeAttestation analog: pool + gossip."""
+        from ..p2p.bus import TOPIC_ATTESTATION
+
+        if sum(att.aggregation_bits) == 1:
+            self.node.att_pool.save_unaggregated(att)
+        else:
+            self.node.att_pool.save_aggregated(att)
+        self.node.peer.broadcast(TOPIC_ATTESTATION,
+                                 Attestation.serialize(att))
+
+    # --- node status -------------------------------------------------------
+
+    def node_health(self) -> dict:
+        chain = self.node.chain
+        return {
+            "head_slot": chain.head_slot(),
+            "head_root": chain.head_root.hex(),
+            "justified_epoch": chain.justified_checkpoint.epoch,
+            "finalized_epoch": chain.finalized_checkpoint.epoch,
+            "peers": len(self.node.peer.peers()),
+            "services": self.node.registry.statuses(),
+        }

@@ -1,0 +1,113 @@
+"""HTTP REST gateway over the in-process API.
+
+Reference analog: the Eth Beacon API REST gateway + monitoring
+endpoints (``/eth/v1/node/health``, ``/metrics``) [U, SURVEY.md §2
+"RPC", "monitoring"].  stdlib http.server; JSON bodies; SSZ payloads
+hex-encoded — enough surface for external tooling parity without
+bringing in a web stack.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..proto import Attestation
+
+
+class BeaconHTTPServer:
+    """Serves node status, duties, attestation data, submissions."""
+
+    def __init__(self, node, api, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.node = node
+        self.api = api
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # quiet test output
+                pass
+
+            def _send(self, code: int, body, content_type="application/json"):
+                data = (json.dumps(body).encode()
+                        if content_type == "application/json"
+                        else body.encode())
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    outer._handle_get(self)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": repr(e)})
+
+            def do_POST(self):
+                try:
+                    outer._handle_post(self)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": repr(e)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_port
+        self._thread: threading.Thread | None = None
+
+    # --- routes ------------------------------------------------------------
+
+    def _handle_get(self, h) -> None:
+        path, _, query = h.path.partition("?")
+        params = dict(kv.split("=", 1) for kv in query.split("&") if "=" in kv)
+        if path == "/eth/v1/node/health":
+            h._send(200, self.api.node_health())
+        elif path == "/metrics":
+            h._send(200, self.node.metrics.render(),
+                    content_type="text/plain")
+        elif path == "/eth/v1/validator/attestation_data":
+            data = self.api.get_attestation_data(
+                int(params["slot"]), int(params["committee_index"]))
+            h._send(200, {
+                "slot": data.slot, "index": data.index,
+                "beacon_block_root": data.beacon_block_root.hex(),
+                "source": {"epoch": data.source.epoch,
+                           "root": data.source.root.hex()},
+                "target": {"epoch": data.target.epoch,
+                           "root": data.target.root.hex()},
+            })
+        elif path == "/eth/v1/beacon/headers/head":
+            root, state = self.node.chain.head()
+            h._send(200, {"root": root.hex(), "slot": state.slot})
+        else:
+            h._send(404, {"error": f"no route {path}"})
+
+    def _handle_post(self, h) -> None:
+        length = int(h.headers.get("Content-Length", 0))
+        body = json.loads(h.rfile.read(length) or b"{}")
+        if h.path == "/eth/v1/beacon/blocks":
+            raw = bytes.fromhex(body["ssz"])
+            signed = self.node.types.SignedBeaconBlock.deserialize(raw)
+            root = self.api.submit_block(signed)
+            h._send(200, {"root": root.hex()})
+        elif h.path == "/eth/v1/beacon/pool/attestations":
+            raw = bytes.fromhex(body["ssz"])
+            att = Attestation.deserialize(raw)
+            self.api.submit_attestation(att)
+            h._send(200, {"ok": True})
+        else:
+            h._send(404, {"error": f"no route {h.path}"})
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -1,0 +1,9 @@
+"""Slasher: slashable-offense detection.
+
+Reference analog: ``beacon-chain/slasher`` + ``db/slasherkv`` [U,
+SURVEY.md §2 "slasherkv + slasher"].
+"""
+
+from .service import Slasher
+
+__all__ = ["Slasher"]

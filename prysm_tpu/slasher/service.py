@@ -1,0 +1,121 @@
+"""Min/max-span surround detection + double-vote detection.
+
+Reference analog: ``beacon-chain/slasher`` over ``db/slasherkv``'s
+min/max span chunks [U, SURVEY.md §2 "slasherkv + slasher"].  Canonical
+span scheme (the reference's chunked design, flattened):
+
+  min_target[v][e] = min target of v's attestations with source > e
+  max_target[v][e] = max target of v's attestations with source < e
+
+For a new attestation (s, t) by validator v:
+  * it SURROUNDS an earlier vote  iff min_target[v][s] < t
+  * it IS SURROUNDED by an earlier vote iff max_target[v][s] > t
+  * same target, different signing root = double vote.
+
+Recording (s, t) updates two contiguous slices:
+  min_target[v][0:s]   = min(·, t)      (this att has source > e there)
+  max_target[v][s+1:]  = max(·, t)      (this att has source < e there)
+
+TPU-first shape: spans are numpy arrays ((n_validators, history));
+updates/checks are vectorized slice min/max over the attesting-index
+axis — the same batched layout a device offload would use, with no
+per-epoch Python loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..proto import AttesterSlashing, IndexedAttestation
+
+_NO_MIN = np.iinfo(np.int64).max
+
+
+class Slasher:
+    """Detects slashable attestations; emits AttesterSlashing ops."""
+
+    def __init__(self, n_validators: int, history: int = 4096):
+        self.history = history
+        self.n = n_validators
+        self._min_target = np.full((n_validators, history), _NO_MIN,
+                                   dtype=np.int64)
+        self._max_target = np.full((n_validators, history), -1,
+                                   dtype=np.int64)
+        # (validator, target) -> (source, root, attestation)
+        self._votes: dict[tuple[int, int], tuple[int, bytes, object]] = {}
+
+    def _grow(self, n: int) -> None:
+        if n <= self.n:
+            return
+        extra = n - self.n
+        self._min_target = np.concatenate([
+            self._min_target,
+            np.full((extra, self.history), _NO_MIN, dtype=np.int64)])
+        self._max_target = np.concatenate([
+            self._max_target,
+            np.full((extra, self.history), -1, dtype=np.int64)])
+        self.n = n
+
+    # --- ingestion ---------------------------------------------------------
+
+    def process_attestation(self, indexed: IndexedAttestation,
+                            signing_root: bytes) -> list[AttesterSlashing]:
+        """Check + record one indexed attestation; returns slashing
+        evidence (prior vote, new vote) for every offense found."""
+        source = indexed.data.source.epoch
+        target = indexed.data.target.epoch
+        if target >= self.history or source > target:
+            raise ValueError("attestation epochs outside slasher window")
+        out: list[AttesterSlashing] = []
+        idx_list = list(indexed.attesting_indices)
+        if not idx_list:
+            return out
+        indices = np.asarray(idx_list, dtype=np.int64)
+        self._grow(int(indices.max()) + 1)
+
+        # --- detection (vectorized pre-checks, per-hit evidence) ----------
+        surrounds = self._min_target[indices, source] < target
+        surrounded = self._max_target[indices, source] > target
+        for vi, hit_s, hit_b in zip(idx_list, surrounds, surrounded):
+            prior = None
+            double = self._votes.get((int(vi), target))
+            if double is not None and double[1] != signing_root:
+                prior = double[2]
+            elif hit_s:
+                prior = self._find_vote(int(vi),
+                                        lambda s, t: source < s
+                                        and t < target)
+            elif hit_b:
+                prior = self._find_vote(int(vi),
+                                        lambda s, t: s < source
+                                        and target < t)
+            if prior is not None:
+                out.append(AttesterSlashing(
+                    attestation_1=prior, attestation_2=indexed))
+
+        # --- recording ----------------------------------------------------
+        for vi in idx_list:
+            self._votes[(int(vi), target)] = (source, signing_root,
+                                              indexed)
+        if source > 0:
+            sl = self._min_target[indices, :source]
+            self._min_target[indices, :source] = np.minimum(sl, target)
+        if source + 1 < self.history:
+            sl = self._max_target[indices, source + 1:]
+            self._max_target[indices, source + 1:] = np.maximum(sl,
+                                                                target)
+        return out
+
+    def _find_vote(self, vi: int, pred):
+        """Evidence retrieval: first recorded vote of ``vi`` matching
+        pred(source, target)."""
+        for (v, t), (s, _root, att) in self._votes.items():
+            if v == vi and pred(s, t):
+                return att
+        return None
+
+    # --- queries -----------------------------------------------------------
+
+    def highest_recorded_target(self, vi: int) -> int | None:
+        targets = [t for (v, t) in self._votes if v == vi]
+        return max(targets) if targets else None

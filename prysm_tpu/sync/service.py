@@ -138,26 +138,45 @@ class SyncService:
         with self._lock:
             if key in self.seen_attestations:
                 return Verdict.IGNORE
-            self.seen_attestations.add(key)
 
         state = self.chain.head_state
         epoch = compute_epoch_at_slot(att.data.slot)
         if att.data.target.epoch != epoch:
+            with self._lock:
+                self.seen_attestations.add(key)   # permanently invalid
             return Verdict.REJECT
         try:
             count = get_committee_count_per_slot(state,
                                                  att.data.target.epoch)
-            if att.data.index >= count:
-                return Verdict.REJECT
-            committee = get_beacon_committee(state, att.data.slot,
-                                             att.data.index)
+            committee = (get_beacon_committee(state, att.data.slot,
+                                              att.data.index)
+                         if att.data.index < count else None)
         except Exception:
+            # shuffling not derivable yet: transient — NOT marked
+            # seen, so a re-gossip after head advances can retry
             return Verdict.IGNORE
-        if len(att.aggregation_bits) != len(committee):
+        if (committee is None
+                or len(att.aggregation_bits) != len(committee)):
+            with self._lock:
+                self.seen_attestations.add(key)
             return Verdict.REJECT
         n_bits = sum(att.aggregation_bits)
         if n_bits == 0:
+            with self._lock:
+                self.seen_attestations.add(key)
             return Verdict.REJECT
+        # signature bytes must decode to a valid subgroup point NOW —
+        # a malformed signature must not poison the slot batch later
+        try:
+            from ..crypto.bls import bls as _bls
+
+            _bls.Signature.from_bytes(att.signature)
+        except ValueError:
+            with self._lock:
+                self.seen_attestations.add(key)
+            return Verdict.REJECT
+        with self._lock:
+            self.seen_attestations.add(key)
         if n_bits == 1:
             self.att_pool.save_unaggregated(att)
         else:
@@ -168,7 +187,14 @@ class SyncService:
     def verify_slot_batch(self, slot: int) -> bool:
         """The per-slot device dispatch: verify every pooled
         attestation of ``slot`` in one RLC batch; on success, feed
-        fork-choice votes."""
+        fork-choice votes.  On failure, fall back to per-attestation
+        verification so one bad signature cannot suppress the whole
+        slot's honest votes (reference behavior: per-message gossip
+        verification; here the batch is the fast path and the split
+        is the recovery path)."""
+        from ..core.helpers import is_valid_indexed_attestation
+        from ..core.helpers import get_indexed_attestation
+
         state = self.chain.head_state
         batch = self.att_pool.build_slot_signature_batch(state, slot)
         if len(batch) == 0:
@@ -176,11 +202,27 @@ class SyncService:
         ok = batch.verify()
         if self.metrics is not None:
             self.metrics.inc("slot_batch_signatures", len(batch))
+        all_atts = [att
+                    for _, g in self.att_pool.groups_for_slot(slot).items()
+                    for att in g.aggregated + g.unaggregated]
         if ok:
-            for _, g in self.att_pool.groups_for_slot(slot).items():
-                for att in g.aggregated + g.unaggregated:
-                    self.chain.process_attestation_votes(state, att)
-        return ok
+            for att in all_atts:
+                self.chain.process_attestation_votes(state, att)
+            return True
+        if self.metrics is not None:
+            self.metrics.inc("slot_batch_fallbacks")
+        any_bad = False
+        for att in all_atts:
+            try:
+                indexed = get_indexed_attestation(state, att)
+                valid = is_valid_indexed_attestation(state, indexed)
+            except Exception:
+                valid = False
+            if valid:
+                self.chain.process_attestation_votes(state, att)
+            else:
+                any_bad = True
+        return not any_bad
 
     # --- req/resp ----------------------------------------------------------
 

@@ -1,0 +1,12 @@
+"""Validator client.
+
+Reference analog: ``validator/`` (client runner, keymanager,
+slashing-protection DB) [U, SURVEY.md §2 "validator client", §3.4].
+"""
+
+from .keymanager import KeyManager
+from .protection import SlashingProtectionDB, ProtectionError
+from .client import ValidatorClient
+
+__all__ = ["KeyManager", "SlashingProtectionDB", "ProtectionError",
+           "ValidatorClient"]

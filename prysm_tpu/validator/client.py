@@ -1,0 +1,102 @@
+"""Validator client: per-slot duty runner.
+
+Reference analog: ``validator/client.runner`` [U, SURVEY.md §2, §3.4]:
+per-epoch GetDuties, per-slot propose (keymanager sign behind the
+slashing-protection check) and attest flows, aggregation duty.  Runs
+against the in-process ``ValidatorAPI`` (the ✂gRPC boundary of the
+reference collapses to a call).
+"""
+
+from __future__ import annotations
+
+from ..config import beacon_config
+from ..core.helpers import (
+    compute_epoch_at_slot, compute_signing_root, compute_start_slot_at_epoch,
+    get_domain,
+)
+from ..core.transition import _Uint64Box
+from ..crypto.bls import bls
+from ..proto import Attestation
+from .keymanager import KeyManager
+from .protection import ProtectionError, SlashingProtectionDB
+
+
+class ValidatorClient:
+    def __init__(self, api, keymanager: KeyManager,
+                 protection: SlashingProtectionDB | None = None,
+                 types=None):
+        self.api = api
+        self.km = keymanager
+        self.protection = protection or SlashingProtectionDB()
+        self.types = types or api.node.types
+        self._duties_epoch: int | None = None
+        self._duties = []
+        self.proposed = 0
+        self.attested = 0
+        self.protection_refusals = 0
+
+    # --- duty loop ---------------------------------------------------------
+
+    def on_slot(self, slot: int) -> None:
+        """The per-slot tick: refresh duties at epoch start, then
+        propose/attest as assigned."""
+        epoch = compute_epoch_at_slot(slot)
+        if self._duties_epoch != epoch:
+            self._duties = self.api.get_duties(epoch, self.km.pubkeys())
+            self._duties_epoch = epoch
+        for duty in self._duties:
+            if slot in duty.proposer_slots:
+                self.propose(slot, duty)
+        for duty in self._duties:
+            if duty.attester_slot == slot:
+                self.attest(slot, duty)
+
+    # --- propose -----------------------------------------------------------
+
+    def propose(self, slot: int, duty) -> bytes | None:
+        cfg = beacon_config()
+        state = self.api.node.chain.head_state
+        epoch = compute_epoch_at_slot(slot)
+        randao_domain = get_domain(state, cfg.domain_randao, epoch)
+        randao = self.km.sign(
+            duty.pubkey,
+            compute_signing_root(_Uint64Box(epoch), randao_domain))
+        block = self.api.get_block_proposal(slot, randao.to_bytes())
+
+        domain = get_domain(state, cfg.domain_beacon_proposer, epoch)
+        root = compute_signing_root(block, domain)
+        try:
+            self.protection.check_and_record_block(duty.pubkey, slot,
+                                                   root)
+        except ProtectionError:
+            self.protection_refusals += 1
+            return None
+        sig = self.km.sign(duty.pubkey, root)
+        signed = self.types.SignedBeaconBlock(
+            message=block, signature=sig.to_bytes())
+        block_root = self.api.submit_block(signed)
+        self.proposed += 1
+        return block_root
+
+    # --- attest ------------------------------------------------------------
+
+    def attest(self, slot: int, duty) -> Attestation | None:
+        cfg = beacon_config()
+        data = self.api.get_attestation_data(slot, duty.committee_index)
+        state = self.api.node.chain.head_state
+        domain = get_domain(state, cfg.domain_beacon_attester,
+                            data.target.epoch)
+        root = compute_signing_root(data, domain)
+        try:
+            self.protection.check_and_record_attestation(
+                duty.pubkey, data.source.epoch, data.target.epoch, root)
+        except ProtectionError:
+            self.protection_refusals += 1
+            return None
+        sig = self.km.sign(duty.pubkey, root)
+        bits = [v == duty.validator_index for v in duty.committee]
+        att = Attestation(aggregation_bits=bits, data=data,
+                          signature=sig.to_bytes())
+        self.api.submit_attestation(att)
+        self.attested += 1
+        return att

@@ -1,0 +1,45 @@
+"""Key management.
+
+Reference analog: ``validator/keymanager`` (local keystores /
+derived / remote) [U, SURVEY.md §2 "validator client"].  The local
+manager holds secret keys in memory; deterministic derivation mirrors
+the testing/util pattern (the e2e harness's interop keys).  EIP-2335
+keystore files are out of scope offline — the seam (``sign`` by
+pubkey) matches, which is what the client codes against.
+"""
+
+from __future__ import annotations
+
+from ..crypto.bls import bls
+
+
+class KeyManager:
+    def __init__(self):
+        self._keys: dict[bytes, bls.SecretKey] = {}   # pubkey -> sk
+
+    @classmethod
+    def deterministic(cls, n: int, offset: int = 0) -> "KeyManager":
+        """Interop-style derived keys [reference: deterministic e2e
+        keygen]."""
+        km = cls()
+        for i in range(offset, offset + n):
+            sk, pk = bls.deterministic_keypair(i)
+            km._keys[pk.to_bytes()] = sk
+        return km
+
+    def add(self, sk: bls.SecretKey) -> bytes:
+        pk = sk.public_key().to_bytes()
+        self._keys[pk] = sk
+        return pk
+
+    def pubkeys(self) -> list[bytes]:
+        return list(self._keys)
+
+    def has(self, pubkey: bytes) -> bool:
+        return pubkey in self._keys
+
+    def sign(self, pubkey: bytes, signing_root: bytes) -> bls.Signature:
+        sk = self._keys.get(pubkey)
+        if sk is None:
+            raise KeyError("unknown pubkey")
+        return sk.sign(signing_root)

@@ -1,0 +1,114 @@
+"""Slashing-protection database.
+
+Reference analog: ``validator/db`` slashing protection + EIP-3076
+interchange [U, SURVEY.md §2 "validator client", §5
+"Failure detection/recovery"]: before signing, check (and record)
+block slots and attestation source/target epochs per pubkey; refuse
+double proposals, double votes, and surround votes.  Persisted via
+the same KV store as the beacon DB so a restart cannot double-sign.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..db.kv import KVStore
+
+
+class ProtectionError(Exception):
+    """Signing refused: would be slashable."""
+
+
+class SlashingProtectionDB:
+    def __init__(self, path: str = ":memory:"):
+        self.store = KVStore(path)
+        self._blocks = self.store.bucket("proposed_blocks")
+        self._atts = self.store.bucket("signed_attestations")
+
+    # --- proposals ---------------------------------------------------------
+
+    def check_and_record_block(self, pubkey: bytes, slot: int,
+                               signing_root: bytes) -> None:
+        key = pubkey + int(slot).to_bytes(8, "big")
+        existing = self._blocks.get(key)
+        if existing is not None and existing != signing_root:
+            raise ProtectionError(
+                f"double proposal at slot {slot}")
+        self._blocks.put(key, signing_root)
+
+    def lowest_signed_block_slot(self, pubkey: bytes) -> int | None:
+        for k, _ in self._blocks.scan(pubkey, pubkey + b"\xff" * 8):
+            return int.from_bytes(k[len(pubkey):], "big")
+        return None
+
+    # --- attestations ------------------------------------------------------
+
+    def check_and_record_attestation(self, pubkey: bytes,
+                                     source_epoch: int,
+                                     target_epoch: int,
+                                     signing_root: bytes) -> None:
+        if source_epoch > target_epoch:
+            raise ProtectionError("source after target")
+        key = pubkey + int(target_epoch).to_bytes(8, "big")
+        existing = self._atts.get(key)
+        if existing is not None:
+            rec = json.loads(existing)
+            if bytes.fromhex(rec["root"]) != signing_root:
+                raise ProtectionError(
+                    f"double vote at target epoch {target_epoch}")
+        # surround checks against every recorded attestation
+        for k, v in self._atts.scan(pubkey, pubkey + b"\xff" * 8):
+            rec = json.loads(v)
+            s, t = rec["source"], int.from_bytes(k[len(pubkey):], "big")
+            if source_epoch < s and t < target_epoch:
+                raise ProtectionError(
+                    f"would surround vote ({s},{t})")
+            if s < source_epoch and target_epoch < t:
+                raise ProtectionError(
+                    f"would be surrounded by vote ({s},{t})")
+        self._atts.put(key, json.dumps(
+            {"source": source_epoch, "root": signing_root.hex()}).encode())
+
+    # --- EIP-3076 interchange ----------------------------------------------
+
+    def export_interchange(self, genesis_validators_root: bytes = b"") -> dict:
+        data: dict[str, dict] = {}
+        for k, v in self._blocks.scan():
+            pk, slot = k[:-8].hex(), int.from_bytes(k[-8:], "big")
+            entry = data.setdefault(pk, {"signed_blocks": [],
+                                         "signed_attestations": []})
+            entry["signed_blocks"].append({"slot": str(slot)})
+        for k, v in self._atts.scan():
+            pk, target = k[:-8].hex(), int.from_bytes(k[-8:], "big")
+            rec = json.loads(v)
+            entry = data.setdefault(pk, {"signed_blocks": [],
+                                         "signed_attestations": []})
+            entry["signed_attestations"].append({
+                "source_epoch": str(rec["source"]),
+                "target_epoch": str(target)})
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root":
+                    genesis_validators_root.hex(),
+            },
+            "data": [{"pubkey": "0x" + pk, **entry}
+                     for pk, entry in sorted(data.items())],
+        }
+
+    def import_interchange(self, interchange: dict) -> None:
+        for entry in interchange.get("data", []):
+            pk = bytes.fromhex(entry["pubkey"].removeprefix("0x"))
+            for blk in entry.get("signed_blocks", []):
+                key = pk + int(blk["slot"]).to_bytes(8, "big")
+                if self._blocks.get(key) is None:
+                    self._blocks.put(key, b"\x00" * 32)
+            for att in entry.get("signed_attestations", []):
+                key = pk + int(att["target_epoch"]).to_bytes(8, "big")
+                if self._atts.get(key) is None:
+                    self._atts.put(key, json.dumps({
+                        "source": int(att["source_epoch"]),
+                        "root": (b"\x00" * 32).hex()}).encode())
+
+    def close(self) -> None:
+        self.store.close()

@@ -149,6 +149,46 @@ class TestGossipTwoNodes:
         # the north-star dispatch: one batch verify for the slot
         assert sync_b.verify_slot_batch(1)
 
+    def test_malformed_signature_attestation_rejected(self, genesis,
+                                                      types):
+        """96 bytes that are not a valid G2 point must REJECT at
+        gossip time, not poison the slot batch later."""
+        bus = GossipBus()
+        chain_a, sync_a, peer_a, _ = make_node(bus, "a", genesis, types)
+        chain_b, sync_b, peer_b, pool_b = make_node(bus, "b", genesis,
+                                                    types)
+        att = testutil.valid_attestation(chain_b.head_state, 1, 0)
+        bad = Attestation(aggregation_bits=att.aggregation_bits,
+                          data=att.data, signature=b"\x11" * 96)
+        verdicts = peer_a.broadcast(
+            TOPIC_ATTESTATION, Attestation.serialize(bad))
+        assert verdicts["b"] == Verdict.REJECT
+        assert pool_b.aggregated_count() == 0
+        # and the slot tick survives with the pool empty
+        assert sync_b.verify_slot_batch(1)
+
+    def test_batch_fallback_preserves_honest_votes(self, genesis, types):
+        """A wrong-but-well-formed signature fails the batch; the
+        fallback still feeds honest attestations to fork choice."""
+        bus = GossipBus()
+        chain, sync, peer, pool = make_node(bus, "solo", genesis, types)
+        st = genesis.copy()
+        blk = testutil.generate_full_block(st, slot=1)
+        chain.receive_block(blk)
+        good = testutil.valid_attestation(chain.head_state, 1, 0)
+        other = testutil.valid_attestation(chain.head_state, 1, 1)
+        wrong = Attestation(aggregation_bits=good.aggregation_bits,
+                            data=good.data, signature=other.signature)
+        pool.save_aggregated(wrong)     # valid point, wrong message
+        pool.save_aggregated(other)     # honest
+        assert not sync.verify_slot_batch(1)
+        # honest committee-1 validators' votes reached fork choice
+        voted = set(chain.forkchoice.votes.keys())
+        from prysm_tpu.core.helpers import get_beacon_committee
+
+        honest = set(get_beacon_committee(chain.head_state, 1, 1))
+        assert honest <= voted
+
     def test_wrong_committee_attestation_rejected(self, genesis, types):
         bus = GossipBus()
         chain_a, sync_a, peer_a, _ = make_node(bus, "a", genesis, types)

@@ -1,0 +1,216 @@
+"""RPC API + HTTP gateway + validator client tests.
+
+The crowning integration: a validator client drives duties against a
+live node through the API, producing real signed blocks and
+attestations that the node accepts — the reference's e2e minimal
+lifecycle in-process [U, SURVEY.md §3.4, §4]."""
+
+import json
+import urllib.request
+
+import pytest
+
+from prysm_tpu.config import use_mainnet_config, use_minimal_config
+from prysm_tpu.p2p import GossipBus
+from prysm_tpu.proto import build_types
+from prysm_tpu.rpc import APIError, BeaconHTTPServer, ValidatorAPI
+from prysm_tpu.testing import util as testutil
+from prysm_tpu.validator import (
+    KeyManager, ProtectionError, SlashingProtectionDB, ValidatorClient,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_config():
+    use_minimal_config()
+    yield
+    use_mainnet_config()
+
+
+@pytest.fixture(scope="module")
+def types():
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    return build_types(MINIMAL_CONFIG)
+
+
+@pytest.fixture()
+def node(types):
+    from prysm_tpu.node import BeaconNode
+
+    genesis = testutil.deterministic_genesis_state(16, types)
+    bus = GossipBus()
+    n = BeaconNode(bus, "api-node", genesis, types=types)
+    yield n
+    n.stop()
+
+
+class TestValidatorAPI:
+    def test_duties_cover_all_validators(self, node):
+        api = ValidatorAPI(node)
+        km = KeyManager.deterministic(16)
+        duties = api.get_duties(0, km.pubkeys())
+        attesters = {d.validator_index for d in duties
+                     if d.attester_slot >= 0}
+        assert attesters == set(range(16))
+        proposer_slots = sorted(
+            s for d in duties for s in d.proposer_slots)
+        assert proposer_slots and all(1 <= s < 8 for s in proposer_slots)
+
+    def test_block_proposal_rejects_past_slot(self, node):
+        api = ValidatorAPI(node)
+        with pytest.raises(APIError):
+            api.get_block_proposal(0, b"\x00" * 96)
+
+    def test_health(self, node):
+        api = ValidatorAPI(node)
+        h = api.node_health()
+        assert h["head_slot"] == 0
+        assert h["finalized_epoch"] == 0
+
+
+class TestValidatorClient:
+    def test_full_epoch_of_duties(self, node, types):
+        """Client proposes + attests through slots 1..4; node head
+        advances with every proposal and pools fill with single-bit
+        attestations that aggregate."""
+        api = ValidatorAPI(node)
+        km = KeyManager.deterministic(16)
+        vc = ValidatorClient(api, km)
+        for slot in range(1, 5):
+            vc.on_slot(slot)
+            node.att_pool.aggregate_unaggregated()
+            assert node.head_slot() == slot, f"no proposal at {slot}"
+        assert vc.proposed == 4
+        assert vc.attested > 0
+        assert vc.protection_refusals == 0
+        # pool's slot batches verify (north-star dispatch)
+        assert node.sync.verify_slot_batch(3)
+
+    def test_double_proposal_refused(self, node, types):
+        """A conflicting record in the protection DB blocks the
+        proposal and the node's head does not move."""
+        api = ValidatorAPI(node)
+        km = KeyManager.deterministic(16)
+        vc = ValidatorClient(api, km)
+        duties = api.get_duties(0, km.pubkeys())
+        duty = next(d for d in duties if 1 in d.proposer_slots)
+        # simulate an earlier signed block at slot 1 with another root
+        vc.protection.check_and_record_block(duty.pubkey, 1,
+                                             b"\xfe" * 32)
+        assert vc.propose(1, duty) is None
+        assert vc.protection_refusals == 1
+        assert vc.proposed == 0
+        assert node.head_slot() == 0
+
+
+class TestSlashingProtection:
+    def test_double_block_rejected(self):
+        db = SlashingProtectionDB()
+        pk = b"\xaa" * 48
+        db.check_and_record_block(pk, 5, b"\x01" * 32)
+        db.check_and_record_block(pk, 5, b"\x01" * 32)   # same root ok
+        with pytest.raises(ProtectionError):
+            db.check_and_record_block(pk, 5, b"\x02" * 32)
+
+    def test_double_vote_rejected(self):
+        db = SlashingProtectionDB()
+        pk = b"\xbb" * 48
+        db.check_and_record_attestation(pk, 0, 2, b"\x01" * 32)
+        with pytest.raises(ProtectionError):
+            db.check_and_record_attestation(pk, 1, 2, b"\x02" * 32)
+
+    def test_surround_votes_rejected(self):
+        db = SlashingProtectionDB()
+        pk = b"\xcc" * 48
+        db.check_and_record_attestation(pk, 2, 3, b"\x01" * 32)
+        with pytest.raises(ProtectionError):      # surrounds (2,3)
+            db.check_and_record_attestation(pk, 1, 4, b"\x02" * 32)
+        db2 = SlashingProtectionDB()
+        db2.check_and_record_attestation(pk, 1, 4, b"\x01" * 32)
+        with pytest.raises(ProtectionError):      # surrounded by (1,4)
+            db2.check_and_record_attestation(pk, 2, 3, b"\x02" * 32)
+
+    def test_interchange_roundtrip(self):
+        db = SlashingProtectionDB()
+        pk = b"\xdd" * 48
+        db.check_and_record_block(pk, 7, b"\x01" * 32)
+        db.check_and_record_attestation(pk, 0, 1, b"\x02" * 32)
+        dump = db.export_interchange()
+        assert dump["metadata"]["interchange_format_version"] == "5"
+        db2 = SlashingProtectionDB()
+        db2.import_interchange(dump)
+        with pytest.raises(ProtectionError):
+            db2.check_and_record_block(pk, 7, b"\x03" * 32)
+        with pytest.raises(ProtectionError):
+            db2.check_and_record_attestation(pk, 0, 1, b"\x03" * 32)
+
+    def test_persistence_across_restart(self, tmp_path):
+        path = str(tmp_path / "protection.db")
+        db = SlashingProtectionDB(path)
+        pk = b"\xee" * 48
+        db.check_and_record_block(pk, 3, b"\x01" * 32)
+        db.close()
+        db2 = SlashingProtectionDB(path)
+        with pytest.raises(ProtectionError):
+            db2.check_and_record_block(pk, 3, b"\x02" * 32)
+        db2.close()
+
+
+class TestHTTPGateway:
+    def test_health_metrics_and_submission(self, node, types):
+        api = ValidatorAPI(node)
+        srv = BeaconHTTPServer(node, api)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            with urllib.request.urlopen(f"{base}/eth/v1/node/health") as r:
+                health = json.load(r)
+            assert health["head_slot"] == 0
+            with urllib.request.urlopen(f"{base}/metrics") as r:
+                assert r.status == 200
+            with urllib.request.urlopen(
+                    f"{base}/eth/v1/beacon/headers/head") as r:
+                head = json.load(r)
+            assert head["slot"] == 0
+
+            # propose a real block over HTTP
+            km = KeyManager.deterministic(16)
+            vc = ValidatorClient(api, km)
+            duties = api.get_duties(0, km.pubkeys())
+            slot1_duty = next(d for d in duties if 1 in d.proposer_slots)
+            # build + sign manually, submit via HTTP
+            from prysm_tpu.config import beacon_config
+            from prysm_tpu.core.helpers import (
+                compute_signing_root, get_domain,
+            )
+            from prysm_tpu.core.transition import _Uint64Box
+
+            cfg = beacon_config()
+            st = node.chain.head_state
+            randao = km.sign(slot1_duty.pubkey, compute_signing_root(
+                _Uint64Box(0), get_domain(st, cfg.domain_randao, 0)))
+            block = api.get_block_proposal(1, randao.to_bytes())
+            root = compute_signing_root(
+                block, get_domain(st, cfg.domain_beacon_proposer, 0))
+            sig = km.sign(slot1_duty.pubkey, root)
+            signed = types.SignedBeaconBlock(message=block,
+                                             signature=sig.to_bytes())
+            raw = types.SignedBeaconBlock.serialize(signed).hex()
+            req = urllib.request.Request(
+                f"{base}/eth/v1/beacon/blocks",
+                data=json.dumps({"ssz": raw}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                out = json.load(r)
+            assert node.head_slot() == 1
+            assert out["root"] == node.chain.head_root.hex()
+
+            # unknown route 404s
+            try:
+                urllib.request.urlopen(f"{base}/nope")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.stop()

@@ -1,0 +1,94 @@
+"""Slasher detection tests: double votes, surrounds, clean histories."""
+
+import pytest
+
+from prysm_tpu.proto import (
+    AttestationData, Checkpoint, IndexedAttestation,
+)
+from prysm_tpu.slasher import Slasher
+
+
+def att(indices, source, target, root_byte=0):
+    data = AttestationData(
+        slot=target * 8, index=0,
+        beacon_block_root=bytes([root_byte]) * 32,
+        source=Checkpoint(epoch=source, root=b"\x00" * 32),
+        target=Checkpoint(epoch=target, root=b"\x00" * 32))
+    return IndexedAttestation(attesting_indices=sorted(indices),
+                              data=data, signature=b"\x00" * 96)
+
+
+def root(n: int) -> bytes:
+    return bytes([n]) * 32
+
+
+class TestSlasher:
+    def test_clean_history_no_slashing(self):
+        s = Slasher(8)
+        for e in range(5):
+            assert s.process_attestation(
+                att(range(8), e, e + 1), root(e)) == []
+
+    def test_double_vote_detected(self):
+        s = Slasher(8)
+        s.process_attestation(att([1, 2], 0, 3), root(1))
+        hits = s.process_attestation(att([2, 5], 0, 3, root_byte=9),
+                                     root(2))
+        assert len(hits) == 1
+        sl = hits[0]
+        assert 2 in sl.attestation_1.attesting_indices
+        assert 2 in sl.attestation_2.attesting_indices
+
+    def test_same_vote_rebroadcast_not_slashable(self):
+        s = Slasher(8)
+        s.process_attestation(att([1], 0, 3), root(1))
+        assert s.process_attestation(att([1], 0, 3), root(1)) == []
+
+    def test_surround_detected(self):
+        s = Slasher(8)
+        s.process_attestation(att([4], 2, 3), root(1))
+        hits = s.process_attestation(att([4], 1, 5), root(2))
+        assert len(hits) == 1
+        assert hits[0].attestation_1.data.source.epoch == 2
+        assert hits[0].attestation_2.data.source.epoch == 1
+
+    def test_surrounded_detected(self):
+        s = Slasher(8)
+        s.process_attestation(att([6], 1, 6), root(1))
+        hits = s.process_attestation(att([6], 2, 4), root(2))
+        assert len(hits) == 1
+        assert hits[0].attestation_1.data.target.epoch == 6
+
+    def test_adjacent_spans_not_slashable(self):
+        """(1,2) then (2,3): touching but not surrounding."""
+        s = Slasher(8)
+        s.process_attestation(att([3], 1, 2), root(1))
+        assert s.process_attestation(att([3], 2, 3), root(2)) == []
+        # skipping epochs without surround is fine too: (0,1), (2,5)
+        s2 = Slasher(8)
+        s2.process_attestation(att([3], 0, 1), root(1))
+        assert s2.process_attestation(att([3], 2, 5), root(2)) == []
+
+    def test_shared_boundary_not_surround(self):
+        """(s,t)=(2,4) vs (2,6): same source, no surround (that shape
+        can only double-vote at equal targets)."""
+        s = Slasher(8)
+        s.process_attestation(att([2], 2, 4), root(1))
+        assert s.process_attestation(att([2], 2, 6), root(2)) == []
+
+    def test_only_offending_validators_flagged(self):
+        s = Slasher(8)
+        s.process_attestation(att([1, 2, 3], 2, 3), root(1))
+        hits = s.process_attestation(att([3, 4, 5], 1, 5), root(2))
+        assert len(hits) == 1     # only validator 3 surrounds
+
+    def test_grows_validator_set(self):
+        s = Slasher(2)
+        s.process_attestation(att([70], 1, 2), root(1))
+        hits = s.process_attestation(att([70], 0, 4), root(2))
+        assert len(hits) == 1
+
+    def test_out_of_window_rejected(self):
+        s = Slasher(4, history=64)
+        with pytest.raises(ValueError):
+            s.process_attestation(att([0], 1, 100), root(1))
