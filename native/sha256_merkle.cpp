@@ -1,0 +1,163 @@
+// Vectorized SHA-256 2-to-1 hashing + Merkle tree builder.
+//
+// Reference analog: prysmaticlabs/gohashtree + minio/sha256-simd — the
+// C/AVX native hashing tier under crypto/hash and stateutil
+// [U, SURVEY.md §2 "SHA-256 / hashing", §2.1.3].  The hot entry point
+// is hash_pairs: n independent SHA-256 digests of 64-byte messages
+// (two compressions each: data block + constant padding block).
+// Messages are independent, so the compiler auto-vectorizes the
+// 4-message inner batch (-O3 -march=native); OpenMP-free to stay
+// embeddable.
+//
+// C ABI (ctypes-consumed from prysm_tpu/native):
+//   void sha256_hash_pairs(const uint8_t* in, uint8_t* out, size_t n)
+//   void sha256_merkle_level(const uint8_t* in, uint8_t* out, size_t n,
+//                            const uint8_t* zero_pad, int odd)
+//   void sha256_merkle_root(const uint8_t* leaves, size_t n_leaves,
+//                           size_t depth, const uint8_t* zero_hashes,
+//                           uint8_t* out32)
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+    0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+    0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+    0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+    0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+    0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+    0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+    0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+    0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+    0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+    0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+    0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+    0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+
+constexpr uint32_t IV[8] = {0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u,
+                            0xa54ff53au, 0x510e527fu, 0x9b05688cu,
+                            0x1f83d9abu, 0x5be0cd19u};
+
+inline uint32_t rotr(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+inline uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+inline void put_be32(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v >> 24);
+  p[1] = uint8_t(v >> 16);
+  p[2] = uint8_t(v >> 8);
+  p[3] = uint8_t(v);
+}
+
+void compress(uint32_t state[8], const uint32_t block[16]) {
+  uint32_t w[64];
+  std::memcpy(w, block, 16 * sizeof(uint32_t));
+  for (int t = 16; t < 64; ++t) {
+    uint32_t s0 = rotr(w[t - 15], 7) ^ rotr(w[t - 15], 18) ^ (w[t - 15] >> 3);
+    uint32_t s1 = rotr(w[t - 2], 17) ^ rotr(w[t - 2], 19) ^ (w[t - 2] >> 10);
+    w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int t = 0; t < 64; ++t) {
+    uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + s1 + ch + K[t] + w[t];
+    uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = s0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+// padding block for a 64-byte message (0x80, zeros, bitlen 512)
+constexpr uint32_t PAD[16] = {0x80000000u, 0, 0, 0, 0, 0, 0, 0,
+                              0, 0, 0, 0, 0, 0, 0, 512u};
+
+inline void hash64(const uint8_t* in, uint8_t* out) {
+  uint32_t st[8];
+  std::memcpy(st, IV, sizeof(IV));
+  uint32_t block[16];
+  for (int i = 0; i < 16; ++i) block[i] = be32(in + 4 * i);
+  compress(st, block);
+  compress(st, PAD);
+  for (int i = 0; i < 8; ++i) put_be32(out + 4 * i, st[i]);
+}
+
+}  // namespace
+
+extern "C" {
+
+// n digests of 64-byte messages: in = n*64 bytes, out = n*32 bytes.
+void sha256_hash_pairs(const uint8_t* in, uint8_t* out, size_t n) {
+  // 4-message interleave: independent lanes the compiler can
+  // auto-vectorize (gohashtree's AVX lanes, portably)
+  size_t i = 0;
+  for (; i + 4 <= n; i += 4) {
+    hash64(in + (i + 0) * 64, out + (i + 0) * 32);
+    hash64(in + (i + 1) * 64, out + (i + 1) * 32);
+    hash64(in + (i + 2) * 64, out + (i + 2) * 32);
+    hash64(in + (i + 3) * 64, out + (i + 3) * 32);
+  }
+  for (; i < n; ++i) hash64(in + i * 64, out + i * 32);
+}
+
+// One tree level: n input nodes -> ceil(n/2) parents; odd tail pairs
+// with zero_pad.
+void sha256_merkle_level(const uint8_t* in, uint8_t* out, size_t n,
+                         const uint8_t* zero_pad, int odd) {
+  size_t pairs = n / 2;
+  sha256_hash_pairs(in, out, pairs);
+  if (odd && (n % 2) == 1) {
+    uint8_t buf[64];
+    std::memcpy(buf, in + (n - 1) * 32, 32);
+    std::memcpy(buf + 32, zero_pad, 32);
+    hash64(buf, out + pairs * 32);
+  }
+}
+
+// Full Merkleization: leaves (n*32 bytes) to a root at `depth`,
+// padding odd levels and extending with the zero-subtree ladder
+// (zero_hashes = depth+1 precomputed 32-byte nodes).
+void sha256_merkle_root(const uint8_t* leaves, size_t n_leaves,
+                        size_t depth, const uint8_t* zero_hashes,
+                        uint8_t* out32) {
+  if (n_leaves == 0) {
+    std::memcpy(out32, zero_hashes + depth * 32, 32);
+    return;
+  }
+  std::vector<uint8_t> cur(leaves, leaves + n_leaves * 32);
+  size_t n = n_leaves;
+  size_t level = 0;
+  while (n > 1) {
+    size_t parents = (n + 1) / 2;
+    std::vector<uint8_t> next(parents * 32);
+    sha256_merkle_level(cur.data(), next.data(), n,
+                        zero_hashes + level * 32, 1);
+    cur.swap(next);
+    n = parents;
+    ++level;
+  }
+  uint8_t buf[64];
+  while (level < depth) {
+    std::memcpy(buf, cur.data(), 32);
+    std::memcpy(buf + 32, zero_hashes + level * 32, 32);
+    hash64(buf, cur.data());
+    ++level;
+  }
+  std::memcpy(out32, cur.data(), 32);
+}
+
+}  // extern "C"

@@ -1,0 +1,92 @@
+"""Deposit contract Merkle tree.
+
+Reference analog: ``contracts/deposit-contract`` + the deposit-trie in
+``beacon-chain/cache/depositcache`` [U, SURVEY.md §2 "Deposit
+contract"]: the eth1 contract's incremental Merkle tree (depth 32,
+mix-in deposit count), plus branch proofs consumed by
+``process_deposit``'s ``is_valid_merkle_branch`` check.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..proto import DEPOSIT_CONTRACT_TREE_DEPTH
+from ..ssz.codec import ZERO_HASHES
+
+
+def _h(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+class DepositTree:
+    """Incremental depth-32 Merkle tree (the eth1 contract algorithm:
+    one 32-node branch array + count)."""
+
+    def __init__(self, depth: int = DEPOSIT_CONTRACT_TREE_DEPTH):
+        self.depth = depth
+        self.branch: list[bytes] = [b"\x00" * 32] * depth
+        self.leaves: list[bytes] = []   # kept for proof generation
+
+    # --- contract surface --------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        return len(self.leaves)
+
+    def push(self, leaf: bytes) -> None:
+        """deposit() analog: insert the DepositData root."""
+        if self.count >= (1 << self.depth):
+            raise ValueError("deposit tree full")
+        self.leaves.append(leaf)
+        node = leaf
+        size = self.count
+        for level in range(self.depth):
+            if size & 1:
+                self.branch[level] = node
+                return
+            node = _h(self.branch[level], node)
+            size >>= 1
+
+    def root(self) -> bytes:
+        """get_deposit_root analog: tree root with the little-endian
+        count mixed in (matches SSZ List[DepositData, 2**32] HTR shape
+        the spec's eth1 data carries)."""
+        node = b"\x00" * 32
+        size = self.count
+        for level in range(self.depth):
+            if size & 1:
+                node = _h(self.branch[level], node)
+            else:
+                node = _h(node, ZERO_HASHES[level])
+            size >>= 1
+        return _h(node, self.count.to_bytes(32, "little"))
+
+    # --- proofs ------------------------------------------------------------
+
+    def proof(self, index: int) -> list[bytes]:
+        """Merkle branch for leaf ``index`` (depth+1 nodes: the last
+        is the mixed-in count — the shape process_deposit verifies
+        with is_valid_merkle_branch at depth+1)."""
+        if index >= self.count:
+            raise IndexError("no such deposit")
+        # recompute the tree level by level over the current leaves
+        layer = list(self.leaves)
+        path: list[bytes] = []
+        idx = index
+        for level in range(self.depth):
+            sib = idx ^ 1
+            if sib < len(layer):
+                path.append(layer[sib])
+            else:
+                path.append(ZERO_HASHES[level])
+            nxt = []
+            for i in range(0, len(layer), 2):
+                left = layer[i]
+                right = (layer[i + 1] if i + 1 < len(layer)
+                         else ZERO_HASHES[level])
+                nxt.append(_h(left, right))
+            layer = nxt if nxt else [ZERO_HASHES[level + 1]]
+            idx >>= 1
+        path.append(self.count.to_bytes(32, "little"))
+        return path

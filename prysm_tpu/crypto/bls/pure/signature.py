@@ -11,6 +11,7 @@ compression/infinity/sort flag bits).
 from __future__ import annotations
 
 import hashlib
+from functools import lru_cache
 
 from ..params import ETH2_DST, P, R
 from .curve import B1, B2, G1_GEN, add, multiply, neg
@@ -46,7 +47,11 @@ def g1_to_bytes(pt) -> bytes:
     return bytes(b)
 
 
+@lru_cache(maxsize=65536)
 def g1_from_bytes(data: bytes, subgroup_check: bool = False):
+    """Memoized: the subgroup check is a full scalar-mul by r, and
+    the same pubkey bytes are deserialized once per signature check
+    across the node (points are immutable tuples, safe to share)."""
     if len(data) != 48:
         raise ValueError("G1 compressed point must be 48 bytes")
     flags = data[0]
@@ -83,6 +88,7 @@ def g2_to_bytes(pt) -> bytes:
     return bytes(b)
 
 
+@lru_cache(maxsize=16384)
 def g2_from_bytes(data: bytes, subgroup_check: bool = False):
     if len(data) != 96:
         raise ValueError("G2 compressed point must be 96 bytes")
@@ -135,6 +141,7 @@ def deterministic_secret_key(index: int) -> int:
         data = h
 
 
+@lru_cache(maxsize=65536)
 def sk_to_pubkey_point(sk: int):
     return multiply(G1_GEN, sk % R)
 
@@ -146,6 +153,7 @@ def sk_to_pubkey(sk: int) -> bytes:
 # --- core scheme ----------------------------------------------------------
 
 
+@lru_cache(maxsize=16384)
 def sign_point(sk: int, msg: bytes, dst: bytes = ETH2_DST):
     return multiply(hash_to_g2(msg, dst), sk % R)
 

@@ -1,0 +1,13 @@
+"""ctypes bridge to the C++ hashing tier.
+
+Reference analog: the cgo boundary to gohashtree/sha256-simd [U,
+SURVEY.md §2.1.3, §2.2 "cgo Go<->C boundary"].  The library is built
+on demand with g++ (cached under native/build); absent a toolchain,
+callers fall back to hashlib — byte-identical results either way.
+"""
+
+from .hashbridge import (
+    available, hash_pairs_native, merkle_root_native,
+)
+
+__all__ = ["available", "hash_pairs_native", "merkle_root_native"]

@@ -107,9 +107,32 @@ class ValidatorAPI:
         process_slots(work, slot, types)
 
         cfg = beacon_config()
-        att_slot = slot - cfg.min_attestation_inclusion_delay
-        atts = [a for a in self.node.att_pool.aggregated_for_block(
-            slot=att_slot) if a.data.slot + cfg.slots_per_epoch >= slot]
+        # spec inclusion window: any pooled attestation with
+        #   att.slot + MIN_DELAY <= slot <= att.slot + SLOTS_PER_EPOCH
+        # whose source matches the proposal state's justified
+        # checkpoints (skipped-slot attestations stay eligible)
+        from ..core.helpers import (
+            compute_epoch_at_slot as _epoch_at,
+            get_current_epoch, get_previous_epoch,
+        )
+
+        cur_ep = get_current_epoch(work)
+        prev_ep = get_previous_epoch(work)
+        atts = []
+        for a in self.node.att_pool.aggregated_for_block(slot=None):
+            if not (a.data.slot + cfg.min_attestation_inclusion_delay
+                    <= slot <= a.data.slot + cfg.slots_per_epoch):
+                continue
+            t_ep = a.data.target.epoch
+            if t_ep == cur_ep:
+                ok = a.data.source == work.current_justified_checkpoint
+            elif t_ep == prev_ep:
+                ok = a.data.source == work.previous_justified_checkpoint
+            else:
+                ok = False
+            if ok:
+                atts.append(a)
+        atts = atts[:cfg.max_attestations]
 
         body = types.BeaconBlockBody(
             randao_reveal=randao_reveal,
@@ -133,13 +156,14 @@ class ValidatorAPI:
             state_root=b"\x00" * 32,
             body=body,
         )
-        # state root with signatures unverified (proposer signs after)
-        scratch = pre.copy()
+        # state root with signatures unverified (proposer signs after);
+        # `work` is already advanced to `slot`, so the transition's
+        # process_slots is a no-op — epoch processing runs once
         unsigned = types.SignedBeaconBlock(message=block,
                                            signature=b"\x00" * 96)
-        state_transition(scratch, unsigned, types,
+        state_transition(work, unsigned, types,
                          validate_result=False, verify_signatures=False)
-        block.state_root = types.BeaconState.hash_tree_root(scratch)
+        block.state_root = types.BeaconState.hash_tree_root(work)
         return block
 
     def submit_block(self, signed_block) -> bytes:

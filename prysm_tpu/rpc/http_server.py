@@ -14,6 +14,11 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..proto import Attestation
+from .api import APIError
+
+# malformed client input (missing params, bad hex/SSZ, bad slot) maps
+# to 400 per Beacon-API convention; anything else is a true 500
+_CLIENT_ERRORS = (KeyError, ValueError, APIError, json.JSONDecodeError)
 
 
 class BeaconHTTPServer:
@@ -42,12 +47,16 @@ class BeaconHTTPServer:
             def do_GET(self):
                 try:
                     outer._handle_get(self)
+                except _CLIENT_ERRORS as e:
+                    self._send(400, {"error": repr(e)})
                 except Exception as e:  # noqa: BLE001
                     self._send(500, {"error": repr(e)})
 
             def do_POST(self):
                 try:
                     outer._handle_post(self)
+                except _CLIENT_ERRORS as e:
+                    self._send(400, {"error": repr(e)})
                 except Exception as e:  # noqa: BLE001
                     self._send(500, {"error": repr(e)})
 

@@ -41,8 +41,11 @@ class Slasher:
                                    dtype=np.int64)
         self._max_target = np.full((n_validators, history), -1,
                                    dtype=np.int64)
-        # (validator, target) -> (source, root, attestation)
-        self._votes: dict[tuple[int, int], tuple[int, bytes, object]] = {}
+        # (validator, target) -> [(source, root, attestation), ...] —
+        # a list: a same-target double vote must not overwrite the
+        # original, it is still surround evidence for later offenses
+        self._votes: dict[tuple[int, int],
+                          list[tuple[int, bytes, object]]] = {}
 
     def _grow(self, n: int) -> None:
         if n <= self.n:
@@ -78,14 +81,15 @@ class Slasher:
         surrounded = self._max_target[indices, source] > target
         for vi, hit_s, hit_b in zip(idx_list, surrounds, surrounded):
             prior = None
-            double = self._votes.get((int(vi), target))
-            if double is not None and double[1] != signing_root:
-                prior = double[2]
-            elif hit_s:
+            for (s, r, att) in self._votes.get((int(vi), target), []):
+                if r != signing_root:
+                    prior = att
+                    break
+            if prior is None and hit_s:
                 prior = self._find_vote(int(vi),
                                         lambda s, t: source < s
                                         and t < target)
-            elif hit_b:
+            if prior is None and hit_b:
                 prior = self._find_vote(int(vi),
                                         lambda s, t: s < source
                                         and target < t)
@@ -95,8 +99,10 @@ class Slasher:
 
         # --- recording ----------------------------------------------------
         for vi in idx_list:
-            self._votes[(int(vi), target)] = (source, signing_root,
-                                              indexed)
+            entries = self._votes.setdefault((int(vi), target), [])
+            if not any(r == signing_root and s == source
+                       for (s, r, _a) in entries):
+                entries.append((source, signing_root, indexed))
         if source > 0:
             sl = self._min_target[indices, :source]
             self._min_target[indices, :source] = np.minimum(sl, target)
@@ -109,9 +115,12 @@ class Slasher:
     def _find_vote(self, vi: int, pred):
         """Evidence retrieval: first recorded vote of ``vi`` matching
         pred(source, target)."""
-        for (v, t), (s, _root, att) in self._votes.items():
-            if v == vi and pred(s, t):
-                return att
+        for (v, t), entries in self._votes.items():
+            if v != vi:
+                continue
+            for (s, _root, att) in entries:
+                if pred(s, t):
+                    return att
         return None
 
     # --- queries -----------------------------------------------------------

@@ -51,6 +51,13 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None
     if limit is not None and count > limit:
         raise ValueError("chunk count exceeds limit")
     depth = size.bit_length() - 1
+    if count >= 256:
+        # large trees take the native tier (gohashtree analog); the
+        # bridge falls back to hashlib when no toolchain — identical
+        # bytes either way
+        from ..native import merkle_root_native
+
+        return merkle_root_native(b"".join(chunks), depth, ZERO_HASHES)
     layer = list(chunks)
     for d in range(depth):
         if len(layer) % 2 == 1:

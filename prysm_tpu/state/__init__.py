@@ -1,0 +1,9 @@
+"""State infrastructure: incremental Merkle field tries.
+
+Reference analog: ``beacon-chain/state/fieldtrie`` + the state-native
+dirty-field root caching [U, SURVEY.md §2 "fieldtrie", "BeaconState"].
+"""
+
+from .fieldtrie import FieldTrie, RegistryTrie
+
+__all__ = ["FieldTrie", "RegistryTrie"]

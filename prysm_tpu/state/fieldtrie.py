@@ -1,0 +1,224 @@
+"""Incremental Merkle tries for hot BeaconState fields.
+
+Reference analog: ``beacon-chain/state/fieldtrie`` (RecomputeTrie:
+re-hash only the paths of dirty indices) [U, SURVEY.md §2
+"fieldtrie"] backing the reference's dirty-field HashTreeRoot caching.
+
+Design: the trie stores every interior level as a numpy uint8 array
+(n_nodes, 32).  Point updates re-hash one root-path (O(log n)
+hashlib calls); bulk updates (epoch-boundary balance sweeps) batch
+each level's dirty parents through the JAX SHA-256 Merkleizer
+(``ssz.merkle_jax.hash_pairs``) — one device dispatch per level, the
+same shape ``stateutil`` feeds gohashtree [U, §2.1.3].
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..ssz.codec import ZERO_HASHES, mix_in_length
+
+_BULK_THRESHOLD = 64   # dirty nodes per level before batching to JAX
+
+
+def _h(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+class FieldTrie:
+    """Fixed-depth incremental Merkle tree over 32-byte leaves with a
+    zero-subtree ladder, list-limit depth, and mix-in-length roots."""
+
+    def __init__(self, leaves: list[bytes], limit: int):
+        if limit <= 0 or (limit & (limit - 1)) != 0:
+            raise ValueError("limit must be a positive power of two")
+        if len(leaves) > limit:
+            raise ValueError("more leaves than limit")
+        self.limit = limit
+        self.depth = limit.bit_length() - 1
+        self.length = len(leaves)
+        # levels[0] = leaves (padded to next pow2 within used range),
+        # levels[d] = interior nodes; each stored as (n, 32) uint8
+        self.levels: list[np.ndarray] = []
+        self._build(leaves)
+
+    # --- construction ------------------------------------------------------
+
+    def _build(self, leaves: list[bytes]) -> None:
+        cur = np.zeros((max(1, self.length), 32), dtype=np.uint8)
+        for i, leaf in enumerate(leaves):
+            cur[i] = np.frombuffer(leaf, dtype=np.uint8)
+        self.levels = [cur]
+        for level in range(self.depth):
+            n = self.levels[level].shape[0]
+            n_par = (n + 1) // 2
+            par = np.zeros((n_par, 32), dtype=np.uint8)
+            zero = ZERO_HASHES[level]
+            lv = self.levels[level]
+            for p in range(n_par):
+                left = lv[2 * p].tobytes()
+                right = (lv[2 * p + 1].tobytes()
+                         if 2 * p + 1 < n else zero)
+                par[p] = np.frombuffer(_h(left, right), dtype=np.uint8)
+            self.levels.append(par)
+
+    # --- queries -----------------------------------------------------------
+
+    def root(self) -> bytes:
+        """Merkle root at the limit depth + mix_in_length."""
+        node = self.levels[self.depth][0].tobytes() \
+            if self.levels[self.depth].shape[0] else ZERO_HASHES[self.depth]
+        return mix_in_length(node, self.length)
+
+    def vector_root(self) -> bytes:
+        """Root without length mix-in (Vector semantics)."""
+        return self.levels[self.depth][0].tobytes()
+
+    def leaf(self, index: int) -> bytes:
+        return self.levels[0][index].tobytes()
+
+    # --- updates -----------------------------------------------------------
+
+    def update(self, index: int, leaf: bytes) -> None:
+        """Point update: re-hash one path (RecomputeTrie for a single
+        dirty index)."""
+        if index >= self.length:
+            raise IndexError("update past length; use append")
+        self.levels[0][index] = np.frombuffer(leaf, dtype=np.uint8)
+        self._rehash_paths([index])
+
+    def append(self, leaf: bytes) -> None:
+        if self.length >= self.limit:
+            raise ValueError("trie full")
+        idx = self.length
+        self.length += 1
+        if idx < self.levels[0].shape[0]:
+            self.levels[0][idx] = np.frombuffer(leaf, dtype=np.uint8)
+        else:
+            self.levels[0] = np.vstack([
+                self.levels[0],
+                np.frombuffer(leaf, dtype=np.uint8)[None]])
+        # grow interior levels as needed, then rehash the path
+        for level in range(self.depth):
+            need = (self.levels[level].shape[0] + 1) // 2
+            if self.levels[level + 1].shape[0] < need:
+                self.levels[level + 1] = np.vstack([
+                    self.levels[level + 1],
+                    np.zeros((need - self.levels[level + 1].shape[0], 32),
+                             dtype=np.uint8)])
+        self._rehash_paths([idx])
+
+    def update_batch(self, updates: dict[int, bytes]) -> None:
+        """Bulk dirty-leaf recompute: one pass per level, batching
+        large levels through the JAX hasher (one dispatch/level)."""
+        if not updates:
+            return
+        # validate BEFORE mutating: a partial write with no rehash
+        # would leave leaf() and root() inconsistent
+        for i in updates:
+            if i >= self.length:
+                raise IndexError("update past length; use append")
+        for i, leaf in updates.items():
+            self.levels[0][i] = np.frombuffer(leaf, dtype=np.uint8)
+        self._rehash_paths(sorted(updates))
+
+    # --- internals ---------------------------------------------------------
+
+    def _rehash_paths(self, dirty: list[int]) -> None:
+        for level in range(self.depth):
+            parents = sorted({i // 2 for i in dirty})
+            lv = self.levels[level]
+            n = lv.shape[0]
+            zero = ZERO_HASHES[level]
+            if len(parents) >= _BULK_THRESHOLD:
+                self._rehash_level_jax(level, parents)
+            else:
+                par = self.levels[level + 1]
+                for p in parents:
+                    if p >= par.shape[0]:
+                        continue
+                    left = lv[2 * p].tobytes()
+                    right = (lv[2 * p + 1].tobytes()
+                             if 2 * p + 1 < n else zero)
+                    par[p] = np.frombuffer(_h(left, right),
+                                           dtype=np.uint8)
+            dirty = parents
+
+    def _rehash_level_jax(self, level: int, parents: list[int]) -> None:
+        """Batch one level's dirty parents through the device hasher."""
+        from ..ssz import merkle_jax
+
+        lv = self.levels[level]
+        n = lv.shape[0]
+        zero_words = np.frombuffer(ZERO_HASHES[level],
+                                   dtype=">u4").astype(np.uint32)
+        pairs = np.zeros((len(parents), 16), dtype=np.uint32)
+        for k, p in enumerate(parents):
+            left = lv[2 * p].tobytes()
+            pairs[k, :8] = np.frombuffer(left, dtype=">u4").astype(
+                np.uint32)
+            if 2 * p + 1 < n:
+                pairs[k, 8:] = np.frombuffer(
+                    lv[2 * p + 1].tobytes(), dtype=">u4").astype(np.uint32)
+            else:
+                pairs[k, 8:] = zero_words
+        out = np.asarray(merkle_jax.hash_pairs(pairs))
+        par = self.levels[level + 1]
+        for k, p in enumerate(parents):
+            if p < par.shape[0]:
+                par[p] = np.frombuffer(
+                    out[k].astype(">u4").tobytes(), dtype=np.uint8)
+
+
+class RegistryTrie(FieldTrie):
+    """Validator-registry specialization: leaves are per-validator
+    HTRs; ``update_validator``/``append_validator`` take containers
+    (stateutil.ValidatorRegistryRoot incremental analog)."""
+
+    def __init__(self, validators, limit: int = 2 ** 40):
+        from ..proto import Validator
+
+        # registry limit is 2^40: model the trie at the used depth and
+        # extend with the zero ladder in root() — a full 2^40 array is
+        # infeasible; depth accounting happens in vector_root
+        self._full_depth = limit.bit_length() - 1
+        used = 1
+        while used < max(1, len(validators)):
+            used *= 2
+        leaves = [Validator.hash_tree_root(v) for v in validators]
+        super().__init__(leaves, used)
+        self._registry_limit = limit
+
+    def root(self) -> bytes:
+        node = self.vector_root()
+        for level in range(self.depth, self._full_depth):
+            node = _h(node, ZERO_HASHES[level])
+        return mix_in_length(node, self.length)
+
+    def update_validator(self, index: int, validator) -> None:
+        from ..proto import Validator
+
+        self.update(index, Validator.hash_tree_root(validator))
+
+    def append_validator(self, validator) -> None:
+        from ..proto import Validator
+
+        if self.length >= self.limit:
+            self._grow_limit()
+        self.append(Validator.hash_tree_root(validator))
+
+    def _grow_limit(self) -> None:
+        """Double the modeled subtree when the used range fills."""
+        if self.limit * 2 > 2 ** self._full_depth:
+            raise ValueError("registry limit reached")
+        self.limit *= 2
+        self.depth += 1
+        top = self.levels[-1]
+        zero = ZERO_HASHES[self.depth - 1]
+        new_top = np.zeros((1, 32), dtype=np.uint8)
+        if top.shape[0]:
+            new_top[0] = np.frombuffer(
+                _h(top[0].tobytes(), zero), dtype=np.uint8)
+        self.levels.append(new_top)

@@ -2,10 +2,17 @@
 
 Reference analog: ``validator/db`` slashing protection + EIP-3076
 interchange [U, SURVEY.md §2 "validator client", §5
-"Failure detection/recovery"]: before signing, check (and record)
-block slots and attestation source/target epochs per pubkey; refuse
-double proposals, double votes, and surround votes.  Persisted via
-the same KV store as the beacon DB so a restart cannot double-sign.
+"Failure detection/recovery"].  Enforcement is the EIP-3076
+*watermark* discipline (the reference's minimal-slashing-protection
+mode): per pubkey, only sign blocks at strictly increasing slots and
+attestations with non-decreasing source and strictly increasing
+target.  Watermarks make every check O(1) and remain safe under
+minified interchange imports (which legally keep only the highest
+records).  Exact-slot/target re-signing of the *same* root stays
+idempotent so a retried duty is not refused.
+
+Persisted via the same KV store as the beacon DB so a restart cannot
+double-sign.
 """
 
 from __future__ import annotations
@@ -16,7 +23,7 @@ from ..db.kv import KVStore
 
 
 class ProtectionError(Exception):
-    """Signing refused: would be slashable."""
+    """Signing refused: would be (or could be) slashable."""
 
 
 class SlashingProtectionDB:
@@ -24,6 +31,17 @@ class SlashingProtectionDB:
         self.store = KVStore(path)
         self._blocks = self.store.bucket("proposed_blocks")
         self._atts = self.store.bucket("signed_attestations")
+        self._marks = self.store.bucket("watermarks")
+
+    # --- watermarks --------------------------------------------------------
+
+    def _get_marks(self, pubkey: bytes) -> dict:
+        raw = self._marks.get(pubkey)
+        return json.loads(raw) if raw else {
+            "block_slot": -1, "source": -1, "target": -1}
+
+    def _put_marks(self, pubkey: bytes, marks: dict) -> None:
+        self._marks.put(pubkey, json.dumps(marks).encode())
 
     # --- proposals ---------------------------------------------------------
 
@@ -31,15 +49,26 @@ class SlashingProtectionDB:
                                signing_root: bytes) -> None:
         key = pubkey + int(slot).to_bytes(8, "big")
         existing = self._blocks.get(key)
-        if existing is not None and existing != signing_root:
+        if existing is not None:
+            if existing != signing_root:
+                raise ProtectionError(f"double proposal at slot {slot}")
+            return   # identical retry: idempotent
+        marks = self._get_marks(pubkey)
+        if slot <= marks["block_slot"]:
             raise ProtectionError(
-                f"double proposal at slot {slot}")
+                f"slot {slot} not above watermark {marks['block_slot']}")
         self._blocks.put(key, signing_root)
+        marks["block_slot"] = slot
+        self._put_marks(pubkey, marks)
 
     def lowest_signed_block_slot(self, pubkey: bytes) -> int | None:
         for k, _ in self._blocks.scan(pubkey, pubkey + b"\xff" * 8):
             return int.from_bytes(k[len(pubkey):], "big")
         return None
+
+    def highest_signed_block_slot(self, pubkey: bytes) -> int | None:
+        marks = self._get_marks(pubkey)
+        return marks["block_slot"] if marks["block_slot"] >= 0 else None
 
     # --- attestations ------------------------------------------------------
 
@@ -53,21 +82,28 @@ class SlashingProtectionDB:
         existing = self._atts.get(key)
         if existing is not None:
             rec = json.loads(existing)
-            if bytes.fromhex(rec["root"]) != signing_root:
-                raise ProtectionError(
-                    f"double vote at target epoch {target_epoch}")
-        # surround checks against every recorded attestation
-        for k, v in self._atts.scan(pubkey, pubkey + b"\xff" * 8):
-            rec = json.loads(v)
-            s, t = rec["source"], int.from_bytes(k[len(pubkey):], "big")
-            if source_epoch < s and t < target_epoch:
-                raise ProtectionError(
-                    f"would surround vote ({s},{t})")
-            if s < source_epoch and target_epoch < t:
-                raise ProtectionError(
-                    f"would be surrounded by vote ({s},{t})")
+            if (bytes.fromhex(rec["root"]) == signing_root
+                    and rec["source"] == source_epoch):
+                return   # identical retry: idempotent
+            raise ProtectionError(
+                f"double vote at target epoch {target_epoch}")
+        marks = self._get_marks(pubkey)
+        # watermark rule: source monotone non-decreasing, target
+        # strictly increasing => no surround in either direction
+        if target_epoch <= marks["target"]:
+            raise ProtectionError(
+                f"target {target_epoch} not above watermark "
+                f"{marks['target']}")
+        if source_epoch < marks["source"]:
+            raise ProtectionError(
+                f"source {source_epoch} below watermark "
+                f"{marks['source']}")
         self._atts.put(key, json.dumps(
-            {"source": source_epoch, "root": signing_root.hex()}).encode())
+            {"source": source_epoch,
+             "root": signing_root.hex()}).encode())
+        marks["source"] = max(marks["source"], source_epoch)
+        marks["target"] = target_epoch
+        self._put_marks(pubkey, marks)
 
     # --- EIP-3076 interchange ----------------------------------------------
 
@@ -97,18 +133,29 @@ class SlashingProtectionDB:
         }
 
     def import_interchange(self, interchange: dict) -> None:
+        """Import records AND advance watermarks to the maxima, so a
+        minified interchange (highest-only) still blocks everything at
+        or below the recorded high water."""
         for entry in interchange.get("data", []):
             pk = bytes.fromhex(entry["pubkey"].removeprefix("0x"))
+            marks = self._get_marks(pk)
             for blk in entry.get("signed_blocks", []):
-                key = pk + int(blk["slot"]).to_bytes(8, "big")
+                slot = int(blk["slot"])
+                key = pk + slot.to_bytes(8, "big")
                 if self._blocks.get(key) is None:
                     self._blocks.put(key, b"\x00" * 32)
+                marks["block_slot"] = max(marks["block_slot"], slot)
             for att in entry.get("signed_attestations", []):
-                key = pk + int(att["target_epoch"]).to_bytes(8, "big")
+                src = int(att["source_epoch"])
+                tgt = int(att["target_epoch"])
+                key = pk + tgt.to_bytes(8, "big")
                 if self._atts.get(key) is None:
                     self._atts.put(key, json.dumps({
-                        "source": int(att["source_epoch"]),
+                        "source": src,
                         "root": (b"\x00" * 32).hex()}).encode())
+                marks["source"] = max(marks["source"], src)
+                marks["target"] = max(marks["target"], tgt)
+            self._put_marks(pk, marks)
 
     def close(self) -> None:
         self.store.close()

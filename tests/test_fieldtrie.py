@@ -1,0 +1,118 @@
+"""Field trie tests: incremental roots must equal full SSZ recompute."""
+
+import hashlib
+
+import pytest
+
+from prysm_tpu import ssz
+from prysm_tpu.state import FieldTrie, RegistryTrie
+
+
+def leaf(i: int) -> bytes:
+    return hashlib.sha256(b"leaf%d" % i).digest()
+
+
+def golden_list_root(leaves, limit):
+    from prysm_tpu.ssz.codec import merkleize_chunks, mix_in_length
+
+    return mix_in_length(merkleize_chunks(list(leaves), limit),
+                         len(leaves))
+
+
+class TestFieldTrie:
+    def test_root_matches_golden(self):
+        leaves = [leaf(i) for i in range(10)]
+        t = FieldTrie(leaves, 16)
+        assert t.root() == golden_list_root(leaves, 16)
+
+    def test_empty(self):
+        t = FieldTrie([], 8)
+        assert t.root() == golden_list_root([], 8)
+
+    def test_point_update(self):
+        leaves = [leaf(i) for i in range(7)]
+        t = FieldTrie(leaves, 8)
+        leaves[3] = leaf(99)
+        t.update(3, leaf(99))
+        assert t.root() == golden_list_root(leaves, 8)
+        assert t.leaf(3) == leaf(99)
+
+    def test_append(self):
+        leaves = [leaf(i) for i in range(3)]
+        t = FieldTrie(leaves, 16)
+        for i in range(3, 9):
+            leaves.append(leaf(i))
+            t.append(leaf(i))
+            assert t.root() == golden_list_root(leaves, 16)
+
+    def test_bulk_update_uses_jax_path(self):
+        n = 300   # > _BULK_THRESHOLD parents at level 0
+        leaves = [leaf(i) for i in range(n)]
+        t = FieldTrie(leaves, 512)
+        updates = {i: leaf(1000 + i) for i in range(0, n, 2)}
+        for i, v in updates.items():
+            leaves[i] = v
+        t.update_batch(updates)
+        assert t.root() == golden_list_root(leaves, 512)
+
+    def test_update_past_length_raises(self):
+        t = FieldTrie([leaf(0)], 8)
+        with pytest.raises(IndexError):
+            t.update(5, leaf(5))
+
+    def test_bad_limit(self):
+        with pytest.raises(ValueError):
+            FieldTrie([], 12)
+
+
+class TestRegistryTrie:
+    def test_matches_ssz_registry_root(self):
+        from prysm_tpu.proto import VALIDATOR_REGISTRY_LIMIT, Validator
+        from prysm_tpu.testing.util import deterministic_genesis_state
+        from prysm_tpu.config import use_minimal_config, use_mainnet_config
+
+        use_minimal_config()
+        try:
+            state = deterministic_genesis_state(16)
+            registry_type = ssz.List(Validator,
+                                     VALIDATOR_REGISTRY_LIMIT)
+            golden = registry_type.hash_tree_root(state.validators)
+            trie = RegistryTrie(state.validators)
+            assert trie.root() == golden
+
+            # incremental update equals full recompute
+            state.validators[5].effective_balance = 17 * 10 ** 9
+            trie.update_validator(5, state.validators[5])
+            assert trie.root() == registry_type.hash_tree_root(
+                state.validators)
+
+            # append a validator
+            new_v = state.validators[0].copy()
+            state.validators.append(new_v)
+            trie.append_validator(new_v)
+            assert trie.root() == registry_type.hash_tree_root(
+                state.validators)
+        finally:
+            use_mainnet_config()
+
+    def test_grow_past_initial_pow2(self):
+        from prysm_tpu.proto import VALIDATOR_REGISTRY_LIMIT, Validator
+        from prysm_tpu.testing.util import deterministic_genesis_state
+        from prysm_tpu.config import use_minimal_config, use_mainnet_config
+
+        use_minimal_config()
+        try:
+            state = deterministic_genesis_state(4)
+            registry_type = ssz.List(Validator,
+                                     VALIDATOR_REGISTRY_LIMIT)
+            trie = RegistryTrie(state.validators)
+            # push past the 4-leaf subtree: growth doubles the modeled
+            # range
+            for _ in range(5):
+                v = state.validators[0].copy()
+                state.validators.append(v)
+                trie.append_validator(v)
+            assert trie.root() == registry_type.hash_tree_root(
+                state.validators)
+        finally:
+            use_mainnet_config()
