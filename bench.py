@@ -138,25 +138,54 @@ def bench_field_throughput():
 
 
 TIERS = [
-    ("slot_verify", bench_slot_verify),
-    ("aggregate_verify", bench_aggregate_verify),
-    ("single_verify", bench_single_verify),
-    ("htr_registry", bench_htr_registry),
-    ("field_throughput", bench_field_throughput),
+    # (name, fn, wall budget seconds — generous for first compiles;
+    # the persistent cache makes reruns fast)
+    ("slot_verify", bench_slot_verify, 2400),
+    ("aggregate_verify", bench_aggregate_verify, 900),
+    ("single_verify", bench_single_verify, 700),
+    ("htr_registry", bench_htr_registry, 500),
+    ("field_throughput", bench_field_throughput, 300),
 ]
 
 
+def _run_tier_subprocess(name: str, budget: int) -> str | None:
+    """Run one tier in a child process with a hard wall-time bound.
+    A SIGALRM in-process cannot interrupt a hung native XLA compile —
+    only killing the process bounds it.  Compile work is shared with
+    later runs through the persistent cache."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--tier", name],
+            capture_output=True, text=True, timeout=budget,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        print(f"# tier {name} exceeded {budget}s", file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr)
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            return line
+    return None
+
+
 def main() -> None:
-    last_err = None
-    for name, fn in TIERS:
-        try:
-            result = fn()
-            print(json.dumps(result))
+    if len(sys.argv) >= 3 and sys.argv[1] == "--tier":
+        # child mode: run exactly one tier in this process
+        fn = dict((n, f) for n, f, _b in TIERS)[sys.argv[2]]
+        print(json.dumps(fn()))
+        return
+    attempted = []
+    for name, fn, budget in TIERS:
+        attempted.append(name)
+        line = _run_tier_subprocess(name, budget)
+        if line is not None:
+            print(line)
             return
-        except Exception as e:  # noqa: BLE001 - fall through to next tier
-            last_err = (name, repr(e))
-            print(f"# tier {name} unavailable: {e!r}", file=sys.stderr)
-    print(json.dumps({"metric": "error", "value": 0, "unit": str(last_err),
+    print(json.dumps({"metric": "error", "value": 0,
+                      "unit": f"all tiers failed: {attempted}",
                       "vs_baseline": 0}))
 
 

@@ -174,7 +174,7 @@ def sharded_slot_verify(mesh, pk_jac, sig_jac, h_jac, r_bits):
         in_specs=(Pspec(None, "sig"), Pspec("sig"), Pspec("sig"),
                   Pspec(None, "sig")),
         out_specs=(Pspec("sig"), Pspec("sig")),
-        check_vma=False,
+        check_rep=False,
     )(tuple(jnp.moveaxis(t, 0, 1) for t in pk_jac), sig_jac, h_jac,
       r_bits)
     # combine: global [r]sig sum and global Fq12 product

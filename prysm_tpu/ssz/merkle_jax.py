@@ -115,20 +115,25 @@ def _zero_node(level: int) -> np.ndarray:
     return np.frombuffer(ZERO_HASHES[level], dtype=">u4").astype(np.uint32)
 
 
-def _merkle_to_root(nodes, depth_limit: int, start_level: int = 0):
+def _merkle_to_root(nodes, depth_limit: int, start_level: int = 0,
+                    hp=None):
     """Reduce (n, 8) nodes to a single root at depth_limit, padding
-    with the zero-subtree ladder (all inside the caller's jit)."""
+    with the zero-subtree ladder (all inside the caller's jit).
+    ``hp`` swaps the pair-hash implementation (XLA scan default;
+    the Pallas kernel passes its own) without duplicating the ladder
+    logic."""
+    hp = hp or hash_pairs
     level = start_level
     while nodes.shape[0] > 1:
         if nodes.shape[0] % 2 == 1:
             pad = jnp.asarray(_zero_node(level))[None]
             nodes = jnp.concatenate([nodes, pad], axis=0)
-        nodes = hash_pairs(nodes.reshape(nodes.shape[0] // 2, 16))
+        nodes = hp(nodes.reshape(nodes.shape[0] // 2, 16))
         level += 1
     root = nodes[0]
     while level < depth_limit:
         zn = jnp.asarray(_zero_node(level))
-        root = hash_pairs(jnp.concatenate([root, zn])[None])[0]
+        root = hp(jnp.concatenate([root, zn])[None])[0]
         level += 1
     return root
 
@@ -148,32 +153,41 @@ def merkleize_device(chunks, depth_limit: int, length: int | None = None):
     return root
 
 
-@jax.jit
-def validator_roots(chunks):
+def _validator_roots_impl(chunks, hp):
     """Per-validator subtree roots: chunks (n, 9, 8) uint32 —
     [pk_hi, pk_lo, wc, eff_bal, slashed, aee, ae, ee, we] — -> (n, 8).
 
     pubkey (48 bytes -> 2 chunks) hashes into field chunk 0; the 8
     field chunks then reduce in 3 levels."""
     n = chunks.shape[0]
-    pk_root = hash_pairs(chunks[:, 0:2].reshape(n, 16))
+    pk_root = hp(chunks[:, 0:2].reshape(n, 16))
     leaves = jnp.concatenate([pk_root[:, None], chunks[:, 2:]], axis=1)
-    l1 = hash_pairs(leaves.reshape(n, 4, 16))          # (n, 4, 8)
-    l2 = hash_pairs(l1.reshape(n, 2, 16))              # (n, 2, 8)
-    return hash_pairs(l2.reshape(n, 16))               # (n, 8)
+    l1 = hp(leaves.reshape(n * 4, 16)).reshape(n, 4, 8)
+    l2 = hp(l1.reshape(n * 2, 16)).reshape(n, 2, 8)
+    return hp(l2.reshape(n, 16))                       # (n, 8)
+
+
+def _registry_root_impl(chunks, limit_depth: int, hp):
+    """Shared registry-root pipeline, parameterized by the pair-hash
+    kernel (XLA scan or Pallas) so the layout lives in ONE place."""
+    roots = _validator_roots_impl(chunks, hp)
+    root = _merkle_to_root(roots, limit_depth, hp=hp)
+    n = chunks.shape[0]
+    len_words = np.frombuffer(int(n).to_bytes(32, "little"),
+                              dtype=">u4").astype(np.uint32)
+    return hp(jnp.concatenate([root, jnp.asarray(len_words)])[None])[0]
+
+
+@jax.jit
+def validator_roots(chunks):
+    return _validator_roots_impl(chunks, hash_pairs)
 
 
 @partial(jax.jit, static_argnums=1)
 def registry_root_device(chunks, limit_depth: int = 40):
     """Full validator-registry hash tree root (BASELINE config #4):
     per-validator subtrees + 2**40-limit list merkleize + length."""
-    roots = validator_roots(chunks)
-    root = _merkle_to_root(roots, limit_depth)
-    n = chunks.shape[0]
-    len_words = np.frombuffer(int(n).to_bytes(32, "little"),
-                              dtype=">u4").astype(np.uint32)
-    return hash_pairs(
-        jnp.concatenate([root, jnp.asarray(len_words)])[None])[0]
+    return _registry_root_impl(chunks, limit_depth, hash_pairs)
 
 
 # --- host packing ----------------------------------------------------------

@@ -1,0 +1,142 @@
+"""Pallas SHA-256 Merkleization kernel (north-star target #2).
+
+Reference analog: gohashtree's AVX multi-buffer SHA-256 [U, SURVEY.md
+§2.1.3] — n independent 2-to-1 compressions per tree level.  TPU
+mapping: messages live in the LANE dimension (each of the 128 lanes
+processes one message), words in the sublane dimension, so every
+round's adds/rotates/xors are straight VPU ops with zero cross-lane
+traffic:
+
+    input  block (16, L): word i of message j at [i, j]
+    output block  (8, L): digest word i of message j
+
+The 64 rounds + message schedule are fully unrolled inside the kernel
+(one VMEM-resident block; no HBM traffic between rounds) — this is
+what the lax.scan XLA fallback in ``merkle_jax`` cannot express as
+tightly.  ``interpret=True`` runs the same kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .merkle_jax import _IV, _K, _PAD_BLOCK
+
+LANES = 128
+_BLOCK_MSGS = 512          # messages per grid step (4 lane-groups)
+
+
+def _rotr(x, n):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _round(st, wt, kt):
+    a, b, c, d, e, f, g, h = st
+    s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+    ch = (e & f) ^ (~e & g)
+    t1 = h + s1 + ch + kt + wt
+    s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
+
+
+def _compress_rounds(state, w0, ks):
+    """Two fori_loops (rounds 0-15, then 16-63 with an in-place
+    rolling 16-row schedule) — keeps the traced graph 1-round-sized
+    so compile stays fast at any batch width.
+
+    state: tuple of 8 (B,) vectors; w0: (16, B) array; ks: (64,)."""
+
+    def body_early(t, carry):
+        w, st = carry
+        wt = jax.lax.dynamic_index_in_dim(w, t, 0, keepdims=False)
+        return w, _round(st, wt, ks[t])
+
+    def body_late(t, carry):
+        w, st = carry
+        w15 = jax.lax.dynamic_index_in_dim(w, (t - 15) % 16, 0, False)
+        w2 = jax.lax.dynamic_index_in_dim(w, (t - 2) % 16, 0, False)
+        w16 = jax.lax.dynamic_index_in_dim(w, t % 16, 0, False)
+        w7 = jax.lax.dynamic_index_in_dim(w, (t - 7) % 16, 0, False)
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
+        wt = w16 + s0 + w7 + s1
+        w = jax.lax.dynamic_update_index_in_dim(w, wt, t % 16, 0)
+        return w, _round(st, wt, ks[t])
+
+    w, st = jax.lax.fori_loop(0, 16, body_early, (w0, tuple(state)))
+    _, st = jax.lax.fori_loop(16, 64, body_late, (w, st))
+    return st
+
+
+def _sha256_pairs_kernel(k_ref, pad_ref, in_ref, out_ref):
+    data = in_ref[:]                       # (16, B) uint32
+    ks = k_ref[:]                          # (64,)
+    width = data.shape[1]
+    iv = [jnp.full((width,), np.uint32(_IV[i])) for i in range(8)]
+    st = _compress_rounds(iv, data, ks)
+    mid = [s + np.uint32(_IV[i]) for i, s in enumerate(st)]
+    pad = jnp.broadcast_to(pad_ref[:][:, None], (16, width))
+    st2 = _compress_rounds(mid, pad, ks)
+    out = jnp.stack([s + m for s, m in zip(st2, mid)])   # (8, B)
+    out_ref[:] = out
+
+
+@partial(jax.jit, static_argnums=(1,))
+def hash_pairs_pallas(pairs_t, interpret: bool = False):
+    """(16, n) uint32 word-transposed messages -> (8, n) digests.
+    n must be a multiple of LANES; grid-strides over _BLOCK_MSGS."""
+    n = pairs_t.shape[1]
+    if n % LANES != 0:
+        raise ValueError(
+            f"message count {n} must be a multiple of {LANES}; "
+            "use hash_pairs_via_pallas for arbitrary batch sizes")
+    # block must divide n exactly; n is a LANES multiple here
+    block = _BLOCK_MSGS if n % _BLOCK_MSGS == 0 else LANES
+    grid = (n // block,)
+    return pl.pallas_call(
+        _sha256_pairs_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, n), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((64,), lambda i: (0,)),     # round constants
+            pl.BlockSpec((16,), lambda i: (0,)),     # padding block
+            pl.BlockSpec((16, block), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((8, block), lambda i: (0, i)),
+        interpret=interpret,
+    )(jnp.asarray(_K, dtype=jnp.uint32),
+      jnp.asarray(_PAD_BLOCK, dtype=jnp.uint32),
+      pairs_t)
+
+
+def hash_pairs_via_pallas(pairs, interpret: bool = False):
+    """Drop-in for merkle_jax.hash_pairs: (n, 16) -> (n, 8), padding
+    the batch up to a lane multiple."""
+    n = pairs.shape[0]
+    n_pad = -(-max(n, 1) // LANES) * LANES
+    padded = jnp.zeros((n_pad, 16), dtype=jnp.uint32)
+    padded = padded.at[:n].set(pairs.astype(jnp.uint32))
+    out_t = hash_pairs_pallas(padded.T, interpret)
+    return out_t.T[:n]
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def registry_root_pallas(chunks, limit_depth: int = 40,
+                         interpret: bool = False):
+    """BASELINE config #4 via the Pallas kernel: the SAME pipeline as
+    merkle_jax.registry_root_device with the pair-hash swapped — the
+    validator layout and list-merkleization ladder live in one
+    place."""
+    from .merkle_jax import _registry_root_impl
+
+    def hp(x):   # (m, 16) -> (m, 8)
+        return hash_pairs_via_pallas(x, interpret)
+
+    return _registry_root_impl(chunks, limit_depth, hp)
