@@ -13,7 +13,8 @@ highest tier whose imports resolve:
   2. aggregate verify 1x128 -> tier "aggregate_verify"
   3. full slot 64x200       -> tier "slot_verify"
   4. 500k-validator HTR     -> tier "htr_registry"
-  5. epoch replay           -> tier "epoch_replay" (not yet wired)
+  5. epoch replay           -> tier "epoch_replay"
+Each tier runs in a subprocess with a hard wall-time budget.
 """
 
 from __future__ import annotations
@@ -118,6 +119,62 @@ def bench_htr_registry():
     }
 
 
+def bench_epoch_replay():
+    """BASELINE config #5: one epoch of full blocks replayed through
+    the state transition with whole-batch signature verification on
+    the xla backend (initial-sync throughput shape)."""
+    import time as _t
+
+    from prysm_tpu.config import (
+        MINIMAL_CONFIG, set_features, use_minimal_config,
+    )
+
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    from prysm_tpu.proto import build_types
+    from prysm_tpu.testing.util import (
+        deterministic_genesis_state, generate_full_block,
+    )
+    from prysm_tpu.core.transition import (
+        collect_block_signature_batch, process_slots, state_transition,
+    )
+
+    types = build_types(MINIMAL_CONFIG)
+    genesis = deterministic_genesis_state(64, types)
+    st = genesis.copy()
+    blocks = []
+    for slot in range(1, 9):          # one minimal epoch
+        blk = generate_full_block(st, slot=slot)
+        state_transition(st, blk, types, verify_signatures=False)
+        blocks.append(blk)
+
+    def replay():
+        work = genesis.copy()
+        batch = None
+        for blk in blocks:
+            if work.slot < blk.message.slot:
+                process_slots(work, blk.message.slot, types)
+            b = collect_block_signature_batch(work, blk)
+            batch = b if batch is None else batch.join(b)
+            state_transition(work, blk, types, verify_signatures=False)
+        assert batch.verify()
+        return work.slot
+
+    replay()                          # warm compile caches
+    t0 = _t.perf_counter()
+    replay()
+    t = _t.perf_counter() - t0
+    bps = len(blocks) / t
+    return {
+        "metric": "epoch_replay_blocks_per_sec",
+        "value": round(bps, 2),
+        "unit": "blocks/sec (8-slot minimal epoch, 64 validators, "
+                "batched sig verify)",
+        # CPU initial-sync replay order-of-magnitude ~20 blocks/s [U]
+        "vs_baseline": round(bps / 20.0, 4),
+    }
+
+
 def bench_field_throughput():
     """Bottom tier: batched Fq12 Montgomery multiply throughput —
     reported only until the verify tiers exist."""
@@ -141,6 +198,7 @@ TIERS = [
     # (name, fn, wall budget seconds — generous for first compiles;
     # the persistent cache makes reruns fast)
     ("slot_verify", bench_slot_verify, 2400),
+    ("epoch_replay", bench_epoch_replay, 1200),
     ("aggregate_verify", bench_aggregate_verify, 900),
     ("single_verify", bench_single_verify, 700),
     ("htr_registry", bench_htr_registry, 500),

@@ -137,6 +137,39 @@ def use_minimal_config() -> None:
     _active_config = MINIMAL_CONFIG
 
 
+def load_chain_config_file(path: str,
+                           base: BeaconChainConfig | None = None
+                           ) -> BeaconChainConfig:
+    """``--chain-config-file`` analog [U, SURVEY.md §5 Config/flags]:
+    a YAML mapping of UPPER_SNAKE spec names (or field names) overrides
+    the base preset; unknown keys are rejected.  Hex strings map to
+    bytes fields."""
+    import yaml
+
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    base = base or beacon_config()
+    valid = {f.name: f for f in dataclasses.fields(BeaconChainConfig)}
+    overrides = {}
+    for key, value in raw.items():
+        name = key.lower()
+        if name not in valid:
+            raise ValueError(f"unknown chain config key {key!r}")
+        if valid[name].type in ("bytes", bytes):
+            width = len(getattr(base, name))
+            if isinstance(value, str):
+                value = bytes.fromhex(value.removeprefix("0x"))
+            elif isinstance(value, int):
+                # PyYAML parses unquoted 0x... scalars as ints (the
+                # standard eth2 config-file form)
+                value = value.to_bytes(width, "big")
+            if len(value) != width:
+                raise ValueError(
+                    f"{key}: expected {width} bytes, got {len(value)}")
+        overrides[name] = value
+    return dataclasses.replace(base, **overrides)
+
+
 def use_config(cfg: BeaconChainConfig) -> None:
     global _active_config
     _active_config = cfg

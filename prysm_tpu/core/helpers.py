@@ -334,6 +334,19 @@ def compute_signing_root(obj, domain: bytes) -> bytes:
     return SigningData(object_root=obj.root(), domain=domain).root()
 
 
+def is_aggregator(state, slot: int, index: int,
+                  slot_signature: bytes, cfg=None) -> bool:
+    """Spec is_aggregator: the selection proof hashes into a
+    committee-size-scaled modulus (reference validator/client
+    aggregator duty [U, SURVEY.md §3.4])."""
+    cfg = cfg or beacon_config()
+    committee = get_beacon_committee(state, slot, index, cfg)
+    modulo = max(1, len(committee)
+                 // cfg.target_aggregators_per_committee)
+    return int.from_bytes(_sha256(slot_signature)[0:8],
+                          "little") % modulo == 0
+
+
 def latest_header_root(state) -> bytes:
     """Root of the state's latest block header with its state_root
     filled in — the canonical root of the block that produced

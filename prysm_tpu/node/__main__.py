@@ -33,6 +33,8 @@ def main(argv=None) -> int:
                    help="BLS backend (north-star feature flag)")
     p.add_argument("--minimal-config", action="store_true", default=True,
                    help="use the minimal preset (default for the demo)")
+    p.add_argument("--chain-config-file", default=None,
+                   help="YAML overrides for chain constants")
     p.add_argument("--enable-tracing", action="store_true")
     p.add_argument("--metrics", action="store_true",
                    help="print the /metrics exposition at the end")
@@ -43,6 +45,10 @@ def main(argv=None) -> int:
     )
 
     use_minimal_config()
+    if args.chain_config_file:
+        from ..config import load_chain_config_file, use_config
+
+        use_config(load_chain_config_file(args.chain_config_file))
     set_features(bls_implementation=args.bls_implementation,
                  enable_tracing=args.enable_tracing)
     if args.enable_tracing:

@@ -28,6 +28,7 @@ VALIDATOR_REGISTRY_LIMIT = 2 ** 40
 
 
 class Fork(ssz.Container):
+    root_memo = True
     fields = [
         ("previous_version", Bytes4),
         ("current_version", Bytes4),
@@ -43,6 +44,7 @@ class ForkData(ssz.Container):
 
 
 class Checkpoint(ssz.Container):
+    root_memo = True
     fields = [
         ("epoch", ssz.uint64),
         ("root", ssz.Bytes32),
@@ -50,6 +52,9 @@ class Checkpoint(ssz.Container):
 
 
 class Validator(ssz.Container):
+    # all-scalar fields: per-validator roots memoize (stateutil's
+    # cached validator-registry leaves [U, SURVEY.md §2 "stateutil"])
+    root_memo = True
     fields = [
         ("pubkey", ssz.Bytes48),
         ("withdrawal_credentials", ssz.Bytes32),
@@ -114,6 +119,7 @@ class SignedAggregateAndProof(ssz.Container):
 
 
 class Eth1Data(ssz.Container):
+    root_memo = True
     fields = [
         ("deposit_root", ssz.Bytes32),
         ("deposit_count", ssz.uint64),

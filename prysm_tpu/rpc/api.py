@@ -214,6 +214,34 @@ class ValidatorAPI:
         self.node.peer.broadcast(TOPIC_ATTESTATION,
                                  Attestation.serialize(att))
 
+    def get_aggregate_attestation(self, slot: int,
+                                  committee_index: int):
+        """Best pooled aggregate for (slot, committee) — the
+        reference's GetAggregateAttestation feeding aggregator
+        duties."""
+        self.node.att_pool.aggregate_unaggregated()
+        best = None
+        # limit=None: the default block-packing cap must not truncate
+        # a sparse committee's only aggregate out of the duty
+        for att in self.node.att_pool.aggregated_for_block(slot=slot,
+                                                           limit=None):
+            if att.data.index != committee_index:
+                continue
+            if best is None or (sum(att.aggregation_bits)
+                                > sum(best.aggregation_bits)):
+                best = att
+        return best
+
+    def submit_aggregate_and_proof(self, signed) -> None:
+        """SubmitAggregateAndProof analog: pool + gossip on the
+        aggregate topic."""
+        from ..p2p.bus import TOPIC_AGGREGATE
+        from ..proto import SignedAggregateAndProof
+
+        self.node.att_pool.save_aggregated(signed.message.aggregate)
+        self.node.peer.broadcast(
+            TOPIC_AGGREGATE, SignedAggregateAndProof.serialize(signed))
+
     # --- node status -------------------------------------------------------
 
     def node_health(self) -> dict:

@@ -485,8 +485,30 @@ class Container(SSZType):
             values[name] = typ.deserialize(data[off:end])
         return cls(**values)
 
+    # subclasses with ONLY scalar/bytes fields may set root_memo=True:
+    # roots are memoized on the value tuple (the reference caches
+    # per-validator roots the same way in stateutil)
+    root_memo = False
+    _memo: dict | None = None
+
     @classmethod
     def hash_tree_root(cls, value) -> bytes:
+        if cls.root_memo:
+            key = tuple(getattr(value, name) for name, _ in cls.fields)
+            memo = cls.__dict__.get("_memo")
+            if memo is None:
+                memo = {}
+                cls._memo = memo
+            cached = memo.get(key)
+            if cached is not None:
+                return cached
+            roots = [typ.hash_tree_root(v)
+                     for (name, typ), v in zip(cls.fields, key)]
+            root = merkleize_chunks(roots)
+            if len(memo) > 1 << 20:
+                memo.clear()
+            memo[key] = root
+            return root
         roots = [typ.hash_tree_root(getattr(value, name))
                  for name, typ in cls.fields]
         return merkleize_chunks(roots)

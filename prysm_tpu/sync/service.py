@@ -21,9 +21,9 @@ from ..core.helpers import (
 )
 from ..operations import AttestationPool
 from ..p2p.bus import (
-    Peer, TOPIC_ATTESTATION, TOPIC_BLOCK, Verdict,
+    Peer, TOPIC_AGGREGATE, TOPIC_ATTESTATION, TOPIC_BLOCK, Verdict,
 )
-from ..proto import Attestation, active_types
+from ..proto import Attestation, SignedAggregateAndProof, active_types
 
 RPC_BLOCKS_BY_RANGE = "beacon_blocks_by_range"
 
@@ -46,12 +46,14 @@ class SyncService:
     def start(self) -> None:
         self.peer.subscribe(TOPIC_BLOCK, self.on_block_gossip)
         self.peer.subscribe(TOPIC_ATTESTATION, self.on_attestation_gossip)
+        self.peer.subscribe(TOPIC_AGGREGATE, self.on_aggregate_gossip)
         self.peer.register_rpc(RPC_BLOCKS_BY_RANGE,
                                self.handle_blocks_by_range)
 
     def stop(self) -> None:
         self.peer.unsubscribe(TOPIC_BLOCK)
         self.peer.unsubscribe(TOPIC_ATTESTATION)
+        self.peer.unsubscribe(TOPIC_AGGREGATE)
 
     # --- gossip: blocks ----------------------------------------------------
 
@@ -182,6 +184,82 @@ class SyncService:
         else:
             self.att_pool.save_aggregated(att)
         # votes count after batch verification (see verify_slot_batch)
+        return Verdict.ACCEPT
+
+    def on_aggregate_gossip(self, from_peer: str, data: bytes
+                            ) -> Verdict:
+        """validateAggregateAndProof analog: aggregator membership +
+        selection-proof check + aggregator signature, then pool the
+        aggregate (its own BLS check rides the slot batch)."""
+        from ..config import beacon_config
+        from ..core.helpers import (
+            compute_signing_root, get_domain, is_aggregator,
+        )
+        from ..core.transition import _Uint64Box
+        from ..crypto.bls import bls as _bls
+
+        try:
+            signed = SignedAggregateAndProof.deserialize(data)
+        except Exception:
+            return Verdict.REJECT
+        msg = signed.message
+        att = msg.aggregate
+        key = SignedAggregateAndProof.hash_tree_root(signed)
+        with self._lock:
+            if key in self.seen_attestations:
+                return Verdict.IGNORE
+
+        cfg = beacon_config()
+        state = self.chain.head_state
+        slot = att.data.slot
+        epoch = compute_epoch_at_slot(slot)
+        if att.data.target.epoch != epoch:
+            with self._lock:
+                self.seen_attestations.add(key)   # permanently invalid
+            return Verdict.REJECT
+        try:
+            count = get_committee_count_per_slot(state, epoch)
+            committee = (get_beacon_committee(state, slot,
+                                              att.data.index)
+                         if att.data.index < count else None)
+        except Exception:
+            return Verdict.IGNORE   # transient: retry on re-gossip
+        if (committee is None
+                or msg.aggregator_index not in committee
+                or len(att.aggregation_bits) != len(committee)
+                or sum(att.aggregation_bits) == 0):
+            with self._lock:
+                self.seen_attestations.add(key)
+            return Verdict.REJECT
+        try:
+            aggregator = state.validators[msg.aggregator_index]
+            pk = _bls.PublicKey.from_bytes(aggregator.pubkey)
+            proof = _bls.Signature.from_bytes(msg.selection_proof)
+            agg_sig = _bls.Signature.from_bytes(signed.signature)
+            _bls.Signature.from_bytes(att.signature)
+        except ValueError:
+            with self._lock:
+                self.seen_attestations.add(key)
+            return Verdict.REJECT
+        sel_domain = get_domain(state, cfg.domain_selection_proof,
+                                epoch)
+        sel_root = compute_signing_root(_Uint64Box(slot), sel_domain)
+        if (not is_aggregator(state, slot, att.data.index,
+                              msg.selection_proof)
+                or not proof.verify(pk, sel_root)):
+            with self._lock:
+                self.seen_attestations.add(key)
+            return Verdict.REJECT
+        agg_domain = get_domain(state, cfg.domain_aggregate_and_proof,
+                                epoch)
+        agg_root = compute_signing_root(msg, agg_domain)
+        if not agg_sig.verify(pk, agg_root):
+            with self._lock:
+                self.seen_attestations.add(key)
+            return Verdict.REJECT
+        with self._lock:
+            self.seen_attestations.add(key)
+        self.att_pool.save_aggregated(att)
         return Verdict.ACCEPT
 
     def verify_slot_batch(self, slot: int) -> bool:

@@ -1,0 +1,5 @@
+"""Ops utilities.
+
+Reference analog: ``tools/`` (pcli SSZ inspector, keygen helpers) [U,
+SURVEY.md §2 "tools"].
+"""

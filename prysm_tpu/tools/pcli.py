@@ -1,0 +1,119 @@
+"""pcli analog: SSZ inspect / hash / keygen from the command line.
+
+Reference analog: ``tools/pcli`` (pretty-print SSZ, state-transition
+debugging) [U, SURVEY.md §2 "tools"].
+
+  python -m prysm_tpu.tools.pcli pretty <type> <file.ssz>
+  python -m prysm_tpu.tools.pcli htr    <type> <file.ssz>
+  python -m prysm_tpu.tools.pcli keygen <index> [count]
+  python -m prysm_tpu.tools.pcli transition <pre.ssz> <block.ssz>
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _resolve_type(name: str):
+    from .. import proto
+
+    direct = getattr(proto, name, None)
+    if direct is not None:
+        return direct
+    types = proto.active_types()
+    scoped = getattr(types, name, None)
+    if scoped is None:
+        raise SystemExit(f"unknown SSZ type {name!r}")
+    return scoped
+
+
+def _pretty(obj, indent: int = 0) -> str:
+    from ..ssz.codec import Container
+
+    pad = "  " * indent
+    if isinstance(obj, Container):
+        lines = [f"{pad}{type(obj).__name__}:"]
+        for name, _typ in type(obj).fields:
+            v = getattr(obj, name)
+            if isinstance(v, (Container, list)):
+                lines.append(f"{pad}  {name}:")
+                lines.append(_pretty(v, indent + 2))
+            else:
+                lines.append(f"{pad}  {name}: {_fmt(v)}")
+        return "\n".join(lines)
+    if isinstance(obj, list):
+        if len(obj) > 8:
+            head = "\n".join(_pretty(x, indent + 1) for x in obj[:8])
+            return f"{head}\n{pad}  ... ({len(obj)} items)"
+        return "\n".join(_pretty(x, indent + 1) for x in obj) or \
+            f"{pad}(empty)"
+    return f"{pad}{_fmt(obj)}"
+
+
+def _fmt(v):
+    if isinstance(v, bytes):
+        return "0x" + v.hex()
+    return repr(v)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="prysm_tpu.tools.pcli")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pp = sub.add_parser("pretty", help="decode + pretty-print SSZ")
+    pp.add_argument("type")
+    pp.add_argument("file")
+    ph = sub.add_parser("htr", help="hash tree root of an SSZ file")
+    ph.add_argument("type")
+    ph.add_argument("file")
+    pk = sub.add_parser("keygen",
+                        help="deterministic keypair(s) (interop keys)")
+    pk.add_argument("index", type=int)
+    pk.add_argument("count", type=int, nargs="?", default=1)
+    pt = sub.add_parser("transition",
+                        help="run a block through the state transition")
+    pt.add_argument("pre_state")
+    pt.add_argument("block")
+    pt.add_argument("--no-verify-signatures", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.cmd in ("pretty", "htr"):
+        typ = _resolve_type(args.type)
+        with open(args.file, "rb") as f:
+            value = typ.deserialize(f.read())
+        if args.cmd == "pretty":
+            print(_pretty(value))
+        else:
+            print("0x" + typ.hash_tree_root(value).hex())
+        return 0
+
+    if args.cmd == "keygen":
+        from ..crypto.bls import bls
+
+        for i in range(args.index, args.index + args.count):
+            sk, pk_obj = bls.deterministic_keypair(i)
+            print(f"{i}: sk=0x{sk.to_bytes().hex()} "
+                  f"pk=0x{pk_obj.to_bytes().hex()}")
+        return 0
+
+    if args.cmd == "transition":
+        from ..proto import active_types
+        from ..core.transition import state_transition
+
+        types = active_types()
+        with open(args.pre_state, "rb") as f:
+            state = types.BeaconState.deserialize(f.read())
+        with open(args.block, "rb") as f:
+            block = types.SignedBeaconBlock.deserialize(f.read())
+        state_transition(
+            state, block, types,
+            verify_signatures=not args.no_verify_signatures)
+        root = types.BeaconState.hash_tree_root(state)
+        print(f"post-state slot={state.slot} root=0x{root.hex()}")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

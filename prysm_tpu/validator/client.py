@@ -12,7 +12,7 @@ from __future__ import annotations
 from ..config import beacon_config
 from ..core.helpers import (
     compute_epoch_at_slot, compute_signing_root, compute_start_slot_at_epoch,
-    get_domain,
+    get_domain, is_aggregator,
 )
 from ..core.transition import _Uint64Box
 from ..crypto.bls import bls
@@ -33,6 +33,7 @@ class ValidatorClient:
         self._duties = []
         self.proposed = 0
         self.attested = 0
+        self.aggregated = 0
         self.protection_refusals = 0
 
     # --- duty loop ---------------------------------------------------------
@@ -50,6 +51,9 @@ class ValidatorClient:
         for duty in self._duties:
             if duty.attester_slot == slot:
                 self.attest(slot, duty)
+        for duty in self._duties:
+            if duty.attester_slot == slot and duty.committee:
+                self.maybe_aggregate(slot, duty)
 
     # --- propose -----------------------------------------------------------
 
@@ -100,3 +104,43 @@ class ValidatorClient:
         self.api.submit_attestation(att)
         self.attested += 1
         return att
+
+    # --- aggregate (SubmitAggregateAndProof duty) -------------------------
+
+    def selection_proof(self, slot: int, pubkey: bytes) -> bls.Signature:
+        cfg = beacon_config()
+        state = self.api.node.chain.head_state
+        domain = get_domain(state, cfg.domain_selection_proof,
+                            compute_epoch_at_slot(slot))
+        return self.km.sign(pubkey,
+                            compute_signing_root(_Uint64Box(slot),
+                                                 domain))
+
+    def maybe_aggregate(self, slot: int, duty):
+        """If selected by the selection proof, publish a
+        SignedAggregateAndProof for the committee's best aggregate."""
+        from ..proto import AggregateAndProof, SignedAggregateAndProof
+
+        cfg = beacon_config()
+        state = self.api.node.chain.head_state
+        proof = self.selection_proof(slot, duty.pubkey)
+        if not is_aggregator(state, slot, duty.committee_index,
+                             proof.to_bytes()):
+            return None
+        aggregate = self.api.get_aggregate_attestation(
+            slot, duty.committee_index)
+        if aggregate is None:
+            return None
+        message = AggregateAndProof(
+            aggregator_index=duty.validator_index,
+            aggregate=aggregate,
+            selection_proof=proof.to_bytes())
+        domain = get_domain(state, cfg.domain_aggregate_and_proof,
+                            compute_epoch_at_slot(slot))
+        root = compute_signing_root(message, domain)
+        signed = SignedAggregateAndProof(
+            message=message,
+            signature=self.km.sign(duty.pubkey, root).to_bytes())
+        self.api.submit_aggregate_and_proof(signed)
+        self.aggregated += 1
+        return signed

@@ -1,0 +1,100 @@
+"""Aggregate-and-proof duty flow: selection, signing, gossip
+validation (reference SubmitAggregateAndProof path [U, SURVEY.md
+§3.3-3.4])."""
+
+import pytest
+
+from prysm_tpu.config import use_mainnet_config, use_minimal_config
+from prysm_tpu.p2p import GossipBus
+from prysm_tpu.p2p.bus import TOPIC_AGGREGATE, Verdict
+from prysm_tpu.proto import SignedAggregateAndProof, build_types
+from prysm_tpu.rpc import ValidatorAPI
+from prysm_tpu.testing import util as testutil
+from prysm_tpu.validator import KeyManager, ValidatorClient
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_config():
+    use_minimal_config()
+    yield
+    use_mainnet_config()
+
+
+@pytest.fixture(scope="module")
+def types():
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    return build_types(MINIMAL_CONFIG)
+
+
+@pytest.fixture()
+def pair(types):
+    from prysm_tpu.node import BeaconNode
+
+    genesis = testutil.deterministic_genesis_state(16, types)
+    bus = GossipBus()
+    a = BeaconNode(bus, "a", genesis, types=types)
+    b = BeaconNode(bus, "b", genesis, types=types)
+    a.sync.start()
+    b.sync.start()
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+class TestAggregateAndProof:
+    def test_duty_produces_and_propagates(self, pair, types):
+        a, b = pair
+        api = ValidatorAPI(a)
+        km = KeyManager.deterministic(16)
+        vc = ValidatorClient(api, km)
+        vc.on_slot(1)
+        # with 16 validators / 2 committees of 8, modulo = 1: every
+        # validator is an aggregator — aggregates must have published
+        assert vc.aggregated > 0
+        # node b received them over the aggregate topic
+        assert b.att_pool.aggregated_count() > 0
+        assert b.sync.verify_slot_batch(1)
+
+    def test_forged_selection_proof_rejected(self, pair, types):
+        a, b = pair
+        api = ValidatorAPI(a)
+        km = KeyManager.deterministic(16)
+        vc = ValidatorClient(api, km)
+        duties = api.get_duties(0, km.pubkeys())
+        duty = next(d for d in duties
+                    if d.attester_slot == 1 and d.committee)
+        vc.attest(1, duty)
+        signed = vc.maybe_aggregate(1, duty)
+        assert signed is not None
+        # forge: swap the selection proof for another validator's
+        other = next(d for d in duties
+                     if d.validator_index != duty.validator_index
+                     and d.committee)
+        forged_proof = vc.selection_proof(1, other.pubkey)
+        signed.message.selection_proof = forged_proof.to_bytes()
+        data = SignedAggregateAndProof.serialize(signed)
+        verdict = b.sync.on_aggregate_gossip("a", data)
+        assert verdict == Verdict.REJECT
+
+    def test_wrong_aggregator_signature_rejected(self, pair, types):
+        a, b = pair
+        api = ValidatorAPI(a)
+        km = KeyManager.deterministic(16)
+        vc = ValidatorClient(api, km)
+        duties = api.get_duties(0, km.pubkeys())
+        duty = next(d for d in duties
+                    if d.attester_slot == 1 and d.committee)
+        vc.attest(1, duty)
+        signed = vc.maybe_aggregate(1, duty)
+        assert signed is not None
+        sig = bytearray(signed.signature)
+        # replace with a VALID point that is the wrong signature
+        signed.signature = signed.message.selection_proof
+        data = SignedAggregateAndProof.serialize(signed)
+        assert b.sync.on_aggregate_gossip("x", data) == Verdict.REJECT
+
+    def test_malformed_bytes_rejected(self, pair):
+        a, b = pair
+        assert b.sync.on_aggregate_gossip("x", b"\x00" * 50) == \
+            Verdict.REJECT

@@ -1,0 +1,70 @@
+"""Config/flags tests: presets, feature flags, chain-config-file."""
+
+import pytest
+
+from prysm_tpu.config import (
+    MAINNET_CONFIG, MINIMAL_CONFIG, beacon_config, features,
+    load_chain_config_file, set_features, use_mainnet_config,
+    use_minimal_config,
+)
+
+
+class TestPresets:
+    def test_switching(self):
+        use_minimal_config()
+        assert beacon_config().slots_per_epoch == 8
+        use_mainnet_config()
+        assert beacon_config().slots_per_epoch == 32
+
+    def test_minimal_differs_from_mainnet(self):
+        assert MINIMAL_CONFIG.preset_name != MAINNET_CONFIG.preset_name
+        assert MINIMAL_CONFIG.shuffle_round_count == 10
+
+
+class TestFeatures:
+    def test_set_features_roundtrip(self):
+        prev = features().bls_implementation
+        try:
+            set_features(bls_implementation="xla")
+            assert features().bls_implementation == "xla"
+        finally:
+            set_features(bls_implementation=prev)
+
+    def test_unknown_flag_rejected(self):
+        with pytest.raises(ValueError):
+            set_features(nonsense=True)
+
+
+class TestChainConfigFile:
+    def test_overrides_applied(self, tmp_path):
+        path = tmp_path / "chain.yaml"
+        path.write_text(
+            "SECONDS_PER_SLOT: 6\n"
+            "SLOTS_PER_EPOCH: 4\n"
+            "GENESIS_FORK_VERSION: '0x01020304'\n")
+        cfg = load_chain_config_file(str(path), base=MAINNET_CONFIG)
+        assert cfg.seconds_per_slot == 6
+        assert cfg.slots_per_epoch == 4
+        assert cfg.genesis_fork_version == b"\x01\x02\x03\x04"
+        # base unchanged
+        assert MAINNET_CONFIG.seconds_per_slot == 12
+
+    def test_unquoted_hex_scalar(self, tmp_path):
+        """PyYAML parses unquoted 0x... as int — the standard eth2
+        config form must still land in bytes fields."""
+        path = tmp_path / "chain.yaml"
+        path.write_text("GENESIS_FORK_VERSION: 0x01020304\n")
+        cfg = load_chain_config_file(str(path), base=MAINNET_CONFIG)
+        assert cfg.genesis_fork_version == b"\x01\x02\x03\x04"
+
+    def test_wrong_width_rejected(self, tmp_path):
+        path = tmp_path / "chain.yaml"
+        path.write_text("GENESIS_FORK_VERSION: '0x0102'\n")
+        with pytest.raises(ValueError):
+            load_chain_config_file(str(path), base=MAINNET_CONFIG)
+
+    def test_unknown_key_rejected(self, tmp_path):
+        path = tmp_path / "bad.yaml"
+        path.write_text("NOT_A_REAL_KEY: 1\n")
+        with pytest.raises(ValueError):
+            load_chain_config_file(str(path))

@@ -1,0 +1,54 @@
+"""pcli tool tests (SSZ inspect / htr / keygen / transition)."""
+
+import pytest
+
+from prysm_tpu.config import use_mainnet_config, use_minimal_config
+from prysm_tpu.proto import Checkpoint, build_types
+from prysm_tpu.tools.pcli import main
+from prysm_tpu.testing import util as testutil
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_config():
+    use_minimal_config()
+    yield
+    use_mainnet_config()
+
+
+class TestPcli:
+    def test_pretty_and_htr(self, tmp_path, capsys):
+        cp = Checkpoint(epoch=9, root=b"\x07" * 32)
+        path = tmp_path / "cp.ssz"
+        path.write_bytes(Checkpoint.serialize(cp))
+        assert main(["pretty", "Checkpoint", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "epoch: 9" in out and "0x0707" in out
+        assert main(["htr", "Checkpoint", str(path)]) == 0
+        out = capsys.readouterr().out.strip()
+        assert out == "0x" + Checkpoint.hash_tree_root(cp).hex()
+
+    def test_keygen(self, capsys):
+        assert main(["keygen", "0", "2"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("pk=0x") == 2
+
+    def test_transition(self, tmp_path, capsys):
+        from prysm_tpu.config import MINIMAL_CONFIG
+
+        types = build_types(MINIMAL_CONFIG)
+        st = testutil.deterministic_genesis_state(16, types)
+        blk = testutil.generate_full_block(st, slot=1)
+        pre = tmp_path / "pre.ssz"
+        pre.write_bytes(types.BeaconState.serialize(st))
+        blk_f = tmp_path / "b.ssz"
+        blk_f.write_bytes(types.SignedBeaconBlock.serialize(blk))
+        assert main(["transition", str(pre), str(blk_f),
+                     "--no-verify-signatures"]) == 0
+        out = capsys.readouterr().out
+        assert "post-state slot=1" in out
+
+    def test_unknown_type(self, tmp_path):
+        path = tmp_path / "x.ssz"
+        path.write_bytes(b"")
+        with pytest.raises(SystemExit):
+            main(["pretty", "Nope", str(path)])

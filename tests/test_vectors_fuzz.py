@@ -45,6 +45,29 @@ class TestGeneratorEncodings:
                                 subgroup_check=True) == pc.G2_GEN
 
 
+class TestInteropKeys:
+    """The deterministic keygen reproduces the PUBLISHED eth2 interop
+    validator keys (sha256(LE index) mod r — the cross-client interop
+    spec), externally grounding key derivation + G1 serialization."""
+
+    KNOWN = [
+        (0,
+         "25295f0d1d592a90b333e26e85149708208e9f8e8bc18f6c77bd62f8ad7a6866",
+         "a99a76ed7796f7be22d5b7e85deeb7c5677e88e511e0b337618f8c4eb61349b"
+         "4bf2d153f649f7b53359fe8b94a38e44c"),
+        (1,
+         "51d0b65185db6989ab0b560d6deed19c7ead0e24b9b6372cbecb1f26bdfad000",
+         "b89bebc699769726a318c8e9971bd3171297c61aea4a6578a7a4f94b547dcba"
+         "5bac16a89108b6b6a1fe3695d1a874a0b"),
+    ]
+
+    def test_interop_keypairs(self):
+        for idx, sk_hex, pk_hex in self.KNOWN:
+            sk, pk = bls.deterministic_keypair(idx)
+            assert sk.to_bytes().hex() == sk_hex
+            assert pk.to_bytes().hex() == pk_hex
+
+
 class TestFrozenSignVectors:
     """Regression anchors: eth2-ciphersuite sign outputs frozen from
     the (judge-verified, RFC-9380-conformant) pure implementation.
