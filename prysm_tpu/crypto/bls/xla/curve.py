@@ -279,9 +279,11 @@ def _flatten(nested):
 # --- batched reductions ----------------------------------------------------
 
 
-def point_sum_tree(ops: FieldOps, pt):
-    """Sum a batch of points along the leading batch axis by halving
-    (log2 rounds of one batched add each)."""
+_SUM_CHUNK = 8
+
+
+def _point_sum_halving(ops: FieldOps, pt):
+    """Halving tree over a small leading axis (unrolled)."""
     X, Y, Z = pt
     n = X.shape[0]
     while n > 1:
@@ -296,6 +298,37 @@ def point_sum_tree(ops: FieldOps, pt):
         X, Y, Z = point_add(ops, a, b)
         n = half
     return (X[0], Y[0], Z[0])
+
+
+def point_sum_tree(ops: FieldOps, pt):
+    """Sum a batch of points along the leading batch axis.
+
+    Large batches scan over chunks of _SUM_CHUNK with a fixed-shape
+    accumulator (ONE point-add graph compiled regardless of n — an
+    unrolled halving tree duplicated log2(n) large add graphs and
+    dominated XLA compile time), then a small unrolled tree folds the
+    accumulator."""
+    X, Y, Z = pt
+    n = X.shape[0]
+    if n <= 2 * _SUM_CHUNK:
+        return _point_sum_halving(ops, pt)
+    pad_n = (-n) % _SUM_CHUNK
+    if pad_n:
+        inf1 = point_inf_like(ops, (X[:1], Y[:1], Z[:1]))
+        X = jnp.concatenate([X] + [inf1[0]] * pad_n, axis=0)
+        Y = jnp.concatenate([Y] + [inf1[1]] * pad_n, axis=0)
+        Z = jnp.concatenate([Z] + [inf1[2]] * pad_n, axis=0)
+    chunks = tuple(
+        t.reshape((t.shape[0] // _SUM_CHUNK, _SUM_CHUNK) + t.shape[1:])
+        for t in (X, Y, Z))
+
+    def body(acc, chunk):
+        return point_add(ops, acc, chunk), None
+
+    init = tuple(t[0] for t in chunks)
+    rest = tuple(t[1:] for t in chunks)
+    acc, _ = lax.scan(body, init, rest)
+    return _point_sum_halving(ops, acc)
 
 
 # --- jitted top-level helpers ----------------------------------------------

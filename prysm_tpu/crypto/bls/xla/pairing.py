@@ -154,9 +154,10 @@ def miller_loop(p_aff, q_aff):
     return T.fq12_conj(f)
 
 
-@jax.jit
-def fq12_prod_tree(f):
-    """Product over the leading batch axis by halving (log2 rounds)."""
+_PROD_CHUNK = 8
+
+
+def _fq12_prod_halving(f):
     n = f.shape[0]
     while n > 1:
         half = (n + 1) // 2
@@ -166,6 +167,31 @@ def fq12_prod_tree(f):
         f = T.fq12_mul(f[:half], f[half:2 * half])
         n = half
     return f[0]
+
+
+@jax.jit
+def fq12_prod_tree(f):
+    """Product over the leading batch axis: chunked scan (ONE fq12_mul
+    graph compiled regardless of n) + small halving tail — the
+    unrolled halving tree duplicated log2(n) large mul graphs and
+    dominated XLA compile time for big batches.  Jitted for the one
+    eager call site (sharded_slot_verify's cross-device combine);
+    in-jit callers inline it."""
+    n = f.shape[0]
+    if n <= 2 * _PROD_CHUNK:
+        return _fq12_prod_halving(f)
+    pad_n = (-n) % _PROD_CHUNK
+    if pad_n:
+        f = jnp.concatenate([f] + [T.fq12_one_like(f[:1])] * pad_n,
+                            axis=0)
+    chunks = f.reshape((f.shape[0] // _PROD_CHUNK, _PROD_CHUNK)
+                       + f.shape[1:])
+
+    def body(acc, chunk):
+        return T.fq12_mul(acc, chunk), None
+
+    acc, _ = lax.scan(body, chunks[0], chunks[1:])
+    return _fq12_prod_halving(acc)
 
 
 @jax.jit

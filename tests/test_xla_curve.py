@@ -68,6 +68,19 @@ class TestG1:
             want = pc.add(want, p)
         assert got == [want]
 
+    def test_sum_tree_chunked_path(self, rng):
+        """n=39 > 2*_SUM_CHUNK exercises the chunked-scan reduction
+        INCLUDING the infinity-padding branch (39 % 8 != 0); must
+        match the pure fold."""
+        pts = rand_g1(rng, 37) + [None, None]   # infinities fold away
+        dev = C.pack_g1_points(pts)
+        total = C.point_sum_tree(C.FP_OPS, dev)
+        got = C.unpack_g1_points(tuple(t[None] for t in total))
+        want = None
+        for p in pts:
+            want = pc.add(want, p)
+        assert got == [want]
+
 
 class TestG2:
     def test_double_add(self, rng):

@@ -57,6 +57,22 @@ class TestPairing:
                           pc.multiply(pc.G2_GEN, rng.randrange(1, R))))
         assert xp.multi_pairing(pairs) == pp.multi_pairing(pairs)
 
+    def test_prod_tree_chunked_path(self, rng):
+        """n=33 > 2*_PROD_CHUNK exercises the chunked-scan Fq12
+        product; parity vs the pure sequential product."""
+        from prysm_tpu.crypto.bls.xla import limbs as L
+        from prysm_tpu.crypto.bls.xla import tower as T
+        from prysm_tpu.crypto.bls.xla.pairing import fq12_prod_tree
+
+        arr = L.rand_canonical(99, (33, 2, 3, 2))
+        out = fq12_prod_tree(arr)
+        want = arr[0]
+        for i in range(1, 33):
+            want = T.fq12_mul(want, arr[i])
+        import jax.numpy as jnp
+
+        assert bool(jnp.all(out == want))
+
     def test_multi_pairing_with_infinity(self, rng):
         """Infinity entries contribute the identity factor."""
         p = pc.multiply(pc.G1_GEN, rng.randrange(1, R))
