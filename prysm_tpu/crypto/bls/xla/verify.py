@@ -33,7 +33,8 @@ from .curve import (
     point_inf_like,
 )
 from .pairing import (
-    final_exponentiation, fq12_prod_tree, is_fq12_one, miller_loop,
+    final_exponentiation_check, fq12_prod_tree, is_fq12_one,
+    miller_loop,
 )
 from . import tower
 
@@ -50,7 +51,7 @@ def _pairing_check(p_x, p_y, q_x, q_y, mask):
     """prod of masked pairings == 1."""
     f = miller_loop((p_x, p_y), (q_x, q_y))
     f = T.fq12_select(mask, f, T.fq12_one_like(f))
-    out = final_exponentiation(fq12_prod_tree(f))
+    out = final_exponentiation_check(fq12_prod_tree(f))
     return is_fq12_one(out)
 
 
@@ -183,7 +184,7 @@ def sharded_slot_verify(mesh, pk_jac, sig_jac, h_jac, r_bits):
     ng_x, ng_y = _neg_g1_affine()
     f_neg = miller_loop((ng_x[None], ng_y[None]), (sx, sy))
     f = jnp.concatenate([f_parts, f_neg], axis=0)
-    out = final_exponentiation(fq12_prod_tree(f))
+    out = final_exponentiation_check(fq12_prod_tree(f))
     return is_fq12_one(out) & ~s_inf[0]
 
 

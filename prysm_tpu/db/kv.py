@@ -128,6 +128,22 @@ class KVStore:
             self._buckets[name] = b
         return b
 
+    def backup(self, dst_path: str) -> None:
+        """Consistent online snapshot (WAL-safe — a raw file copy
+        would miss unflushed WAL pages).  Runs over a SECOND reader
+        connection so the store's lock is never held across the copy:
+        SQLite's backup API is online-safe and concurrent writers keep
+        flowing."""
+        if self.path == ":memory:":
+            raise ValueError("in-memory store has no backing file")
+        src = sqlite3.connect(self.path)
+        dst = sqlite3.connect(dst_path)
+        try:
+            src.backup(dst)
+        finally:
+            dst.close()
+            src.close()
+
     def close(self) -> None:
         with self._lock:
             self._conn.close()

@@ -9,9 +9,13 @@ bringing in a web stack.
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_backup_seq = itertools.count()
 
 from ..proto import Attestation
 from .api import APIError
@@ -88,6 +92,17 @@ class BeaconHTTPServer:
         elif path == "/eth/v1/beacon/headers/head":
             root, state = self.node.chain.head()
             h._send(200, {"root": root.hex(), "slot": state.slot})
+        elif path == "/eth/v1/node/version":
+            h._send(200, {"data": {"version": "prysm_tpu/0.2"}})
+        elif path == "/eth/v1/node/syncing":
+            chain = self.node.chain
+            current = chain.current_slot_at(time.time())
+            head = chain.head_slot()
+            h._send(200, {"data": {
+                "head_slot": head,
+                "sync_distance": max(0, current - head),
+                "is_syncing": current > head + 1,
+            }})
         else:
             h._send(404, {"error": f"no route {path}"})
 
@@ -104,6 +119,18 @@ class BeaconHTTPServer:
             att = Attestation.deserialize(raw)
             self.api.submit_attestation(att)
             h._send(200, {"ok": True})
+        elif h.path == "/db/backup":
+            # monitoring/backup analog: consistent online DB snapshot;
+            # a per-process sequence number keeps same-second backups
+            # from overwriting each other
+            src = self.node.db.store.path
+            if src == ":memory:":
+                h._send(400, {"error": "in-memory db has no file"})
+                return
+            dst = (f"{src}.backup-{int(time.time())}"
+                   f"-{next(_backup_seq)}")
+            self.node.db.store.backup(dst)
+            h._send(200, {"backup": dst})
         else:
             h._send(404, {"error": f"no route {h.path}"})
 

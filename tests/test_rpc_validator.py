@@ -206,6 +206,12 @@ class TestHTTPGateway:
             assert node.head_slot() == 1
             assert out["root"] == node.chain.head_root.hex()
 
+            # version + syncing endpoints
+            with urllib.request.urlopen(f"{base}/eth/v1/node/version") as r:
+                assert "prysm_tpu" in json.load(r)["data"]["version"]
+            with urllib.request.urlopen(f"{base}/eth/v1/node/syncing") as r:
+                assert "sync_distance" in json.load(r)["data"]
+
             # unknown route 404s
             try:
                 urllib.request.urlopen(f"{base}/nope")
@@ -214,3 +220,29 @@ class TestHTTPGateway:
                 assert e.code == 404
         finally:
             srv.stop()
+
+    def test_db_backup_endpoint(self, types, tmp_path):
+        from prysm_tpu.node import BeaconNode
+        from prysm_tpu.db import BeaconDB
+
+        genesis = testutil.deterministic_genesis_state(16, types)
+        bus = GossipBus()
+        node = BeaconNode(bus, "backup-node", genesis,
+                          db_path=str(tmp_path / "b.db"), types=types)
+        api = ValidatorAPI(node)
+        srv = BeaconHTTPServer(node, api)
+        srv.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/db/backup",
+                data=b"{}", headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                out = json.load(r)
+            backup = out["backup"]
+            # the backup is a valid DB with the genesis state
+            db2 = BeaconDB(backup, types=types)
+            assert db2.genesis_state() is not None
+            db2.close()
+        finally:
+            srv.stop()
+            node.stop()

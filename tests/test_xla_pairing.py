@@ -57,6 +57,31 @@ class TestPairing:
                           pc.multiply(pc.G2_GEN, rng.randrange(1, R))))
         assert xp.multi_pairing(pairs) == pp.multi_pairing(pairs)
 
+    def test_check_exponentiation_is_cube_of_exact(self, rng):
+        """final_exponentiation_check == (final_exponentiation)^3
+        exactly: f^(E·3h) = (f^(E·h))^3 — ties the fast check-only
+        exponent to the spec exponent on real Miller outputs."""
+        import jax.numpy as jnp
+
+        from prysm_tpu.crypto.bls.xla import tower as T
+        from prysm_tpu.crypto.bls.xla.curve import (
+            pack_g1_points, pack_g2_points,
+        )
+        from prysm_tpu.crypto.bls.xla.pairing import (
+            final_exponentiation, final_exponentiation_check,
+            miller_loop,
+        )
+
+        g1 = pc.multiply(pc.G1_GEN, 777)
+        g2 = pc.multiply(pc.G2_GEN, 778)
+        x1, y1, _ = pack_g1_points([g1])
+        x2, y2, _ = pack_g2_points([g2])
+        f = miller_loop((x1, y1), (x2, y2))[0]
+        exact = final_exponentiation(f)
+        cubed = T.fq12_mul(T.fq12_sqr(exact), exact)
+        fast = final_exponentiation_check(f)
+        assert bool(jnp.all(cubed == fast))
+
     def test_prod_tree_chunked_path(self, rng):
         """n=33 > 2*_PROD_CHUNK exercises the chunked-scan Fq12
         product; parity vs the pure sequential product."""
