@@ -247,6 +247,34 @@ class TestPendingQueue:
             types.BeaconBlock.hash_tree_root(c2.message))
 
 
+class TestCheckpointSync:
+    def test_node_starts_from_trusted_state(self, genesis, types):
+        """Weak-subjectivity checkpoint sync (SURVEY §5): a fresh node
+        anchors on a trusted mid-chain state instead of genesis and
+        follows the chain from there."""
+        bus = GossipBus()
+        chain_a, sync_a, peer_a, _ = make_node(bus, "a", genesis, types)
+        st = genesis.copy()
+        from prysm_tpu.core.transition import state_transition
+
+        blocks = []
+        for slot in range(1, 4):
+            blk = testutil.generate_full_block(st, slot=slot)
+            chain_a.receive_block(blk)
+            state_transition(st, blk, types, verify_signatures=False)
+            blocks.append(blk)
+        # node b boots from a's slot-3 head state (the trusted
+        # checkpoint), never sees blocks 1-3
+        trusted = chain_a.head_state.copy()
+        chain_b, sync_b, peer_b, _ = make_node(bus, "b", trusted, types)
+        assert chain_b.head_slot() == 3
+        assert chain_b.head_root == chain_a.head_root
+        # and it follows the chain forward via gossip
+        b4 = testutil.generate_full_block(st, slot=4)
+        peer_a.broadcast(TOPIC_BLOCK, types.SignedBeaconBlock.serialize(b4))
+        assert chain_b.head_slot() == 4
+
+
 class TestInitialSync:
     def test_catch_up_from_peer(self, genesis, types):
         bus = GossipBus()
