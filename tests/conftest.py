@@ -22,8 +22,13 @@ os.environ["XLA_FLAGS"] = (
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 # Persistent compilation cache: the limb-arithmetic graphs are big and
 # recompiling them per pytest run would dominate suite time.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      "/root/repo/.jax_cache")
+# SEPARATE from the TPU-run cache (.jax_cache): processes attached to
+# the axon tunnel can deposit CPU-AOT entries compiled with the REMOTE
+# host's machine features (prefer-no-scatter etc.), and loading those
+# locally segfaults (cpu_aot_loader feature-mismatch SIGILL).
+# assign unconditionally: a pre-existing env value (e.g. exported for
+# a TPU run) must NOT keep tests on the TPU-run cache
+os.environ["JAX_COMPILATION_CACHE_DIR"] = "/root/repo/.jax_cache_cpu"
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 import jax  # noqa: E402  (after env setup, before any test imports)
