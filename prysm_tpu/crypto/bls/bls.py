@@ -5,9 +5,11 @@ common/ [U, SURVEY.md §2 "BLS interface"]).
 format; heavy verification dispatches on
 ``features().bls_implementation``:
 
-  pure — trusted host golden model (reference's herumi role)
-  xla  — JAX/TPU batch backend   (reference's blst role + the
-         north-star jax implementation)
+  pure   — trusted host golden model (reference's herumi role)
+  xla    — JAX/TPU batch backend   (reference's blst role + the
+           north-star jax implementation)
+  pallas — the xla pipeline with the hand-written Pallas Montgomery
+           multiply kernel swapped in (xla/pallas_mont.py)
 
 ``SignatureBatch`` accumulates (sig, msg, pk) triples — the structure
 the reference threads from block processing and the attestation pool
@@ -329,15 +331,27 @@ class _XlaBackend:
             pk_jac, (sx, sy, sz), h, r_bits, mask))
 
 
-_BACKENDS = {"pure": _PureBackend, "xla": _XlaBackend}
+class _PallasBackend(_XlaBackend):
+    """The XLA pipeline with the hand-written Pallas Montgomery-mul
+    kernel swapped in at the limb level (xla/pallas_mont.py) — the
+    third implementation tier of SURVEY.md §7 stage 5."""
+
+
+_BACKENDS = {"pure": _PureBackend, "xla": _XlaBackend,
+             "pallas": _PallasBackend}
 
 
 def _backend():
     name = features().bls_implementation
     try:
-        return _BACKENDS[name]
+        backend = _BACKENDS[name]
     except KeyError:
         raise ValueError(f"unknown bls implementation {name!r}") from None
+    if name in ("xla", "pallas"):
+        from .xla import limbs as _L
+
+        _L.set_mul_backend("pallas" if name == "pallas" else "xla")
+    return backend
 
 
 # --- deterministic test keys (testing/util analog) -------------------------
@@ -399,13 +413,15 @@ def compiled_slot_verify(batch):
     return slot_verify_device, args
 
 
-def compiled_fast_aggregate_verify(n_pubkeys: int):
-    """(fn, args) for BASELINE config #2."""
+def compiled_fast_aggregate_verify(n_pubkeys: int, variant: int = 0):
+    """(fn, args) for BASELINE config #2.  ``variant`` varies the
+    message (and thus H(m) and the aggregate signature) — see
+    compiled_single_verify."""
     from .xla import h2c
     from .xla.curve import pack_g1_points, pack_g2_points
     from .xla.verify import fast_aggregate_verify_device
 
-    msg = hashlib.sha256(b"aggregate-root").digest()
+    msg = hashlib.sha256(b"aggregate-root-%d" % variant).digest()
     sks = [ps.deterministic_secret_key(i) for i in range(n_pubkeys)]
     from .pure.hash_to_curve import hash_to_g2 as pure_h2g2
 
@@ -419,15 +435,18 @@ def compiled_fast_aggregate_verify(n_pubkeys: int):
                                           (sx[0], sy[0]))
 
 
-def compiled_single_verify():
-    """(fn, args) for BASELINE config #1."""
+def compiled_single_verify(variant: int = 0):
+    """(fn, args) for BASELINE config #1.  ``variant`` derives a
+    distinct (key, msg, sig) triple so benches can rotate inputs
+    (identical repeated dispatches can hit result caching in the
+    device transport and report artificially fast times)."""
     from .xla import h2c
     from .xla.curve import g1_to_affine, pack_g1_points, pack_g2_points
     from .xla.verify import aggregate_verify_device
     import jax.numpy as jnp
 
-    sk, pk = deterministic_keypair(0)
-    msg = hashlib.sha256(b"single-verify").digest()
+    sk, pk = deterministic_keypair(variant)
+    msg = hashlib.sha256(b"single-verify-%d" % variant).digest()
     sig = sk.sign(msg)
     pk_jac = pack_g1_points([pk.point])
     pk_x, pk_y, pk_inf = g1_to_affine(pk_jac)

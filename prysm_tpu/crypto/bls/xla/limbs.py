@@ -212,9 +212,35 @@ def _mont_reduce(cols):
     return _csub_p(limbs)
 
 
+# The Montgomery-multiply backend is swappable: "xla" is the fused
+# elementwise graph below; "pallas" routes through the hand-written
+# VMEM-resident kernel (pallas_mont.py).  This is the §7-stage-5
+# pure/xla/pallas seam at the level where the FLOPs are.
+_MUL_BACKEND = "xla"
+
+
+def set_mul_backend(name: str) -> None:
+    """Select the fp_mul implementation ("xla" | "pallas").  Dispatch
+    happens at trace time, so switching clears jit caches."""
+    global _MUL_BACKEND
+    if name not in ("xla", "pallas"):
+        raise ValueError(f"unknown mul backend {name!r}")
+    if name != _MUL_BACKEND:
+        _MUL_BACKEND = name
+        jax.clear_caches()
+
+
+def get_mul_backend() -> str:
+    return _MUL_BACKEND
+
+
 @jax.jit
 def fp_mul(a, b):
     """Montgomery product mont(a) * mont(b) -> mont(a*b)."""
+    if _MUL_BACKEND == "pallas":
+        from .pallas_mont import mont_mul_pallas
+
+        return mont_mul_pallas(a, b)
     return _mont_reduce(_mul_columns(a, b))
 
 
