@@ -1,0 +1,509 @@
+// PJRT C-API host bridge (SURVEY.md §7 stage 9, §2.1.1).
+//
+// Reference analog: the cgo boundary between the Go node and the
+// vendored blst C library [U, SURVEY.md §2 "blst binding"].  Here the
+// native side of the boundary is the PJRT C API: this shared library
+// dlopens a PJRT plugin (libtpu.so, or the axon tunnel plugin on this
+// host), creates a client, compiles a StableHLO program exported by
+// the Python side, and exposes a flat C ABI (`pb_*`) that a non-Python
+// node harness can call to dispatch signature-verification batches to
+// the TPU — mirroring how the reference's Go services call into
+// native crypto via cgo.
+//
+// The header `third_party/pjrt_c_api.h` is the public OpenXLA PJRT
+// C API (Apache-2.0), vendored the way the reference vendors blst.
+//
+// ABI sketch (all functions return 0 on success, -1 on error with a
+// message in `err`):
+//   pb_create(so_path, options_spec, &ctx, err, errlen)
+//   pb_device_count(ctx)
+//   pb_platform_name(ctx, out, outlen)
+//   pb_compile(ctx, code, code_len, format, copts, copts_len, &exec, ...)
+//   pb_execute(ctx, exec, inputs[], n_inputs, output, ...)
+//   pb_exec_destroy(ctx, exec); pb_destroy(ctx)
+//
+// `options_spec` is newline-separated "name\ttype\tvalue" with type
+// s (string), i (int64) or b (bool) — the same key/value set the
+// Python registration path passes as PJRT create_options.
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <string.h>
+
+#include <chrono>
+#include <cstdio>
+#include <string>
+#include <vector>
+#include <cstdlib>
+
+#include "third_party/pjrt_c_api.h"
+
+namespace {
+
+struct PbContext {
+  void* dl = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_Device* device = nullptr;  // first addressable device, cached
+};
+
+void set_err(char* err, size_t errlen, const std::string& msg) {
+  if (err && errlen) {
+    snprintf(err, errlen, "%s", msg.c_str());
+  }
+}
+
+// Returns empty string on success, message otherwise.
+std::string check(const PJRT_Api* api, PJRT_Error* e) {
+  if (!e) return "";
+  PJRT_Error_Message_Args margs;
+  memset(&margs, 0, sizeof(margs));
+  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  margs.error = e;
+  api->PJRT_Error_Message(&margs);
+  std::string msg(margs.message, margs.message_size);
+  PJRT_Error_Destroy_Args dargs;
+  memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dargs.error = e;
+  api->PJRT_Error_Destroy(&dargs);
+  return msg;
+}
+
+struct ParsedOptions {
+  // Backing storage must outlive the PJRT_NamedValue views.
+  std::vector<std::string> names;
+  std::vector<std::string> strings;
+  std::vector<int64_t> ints;
+  std::vector<PJRT_NamedValue> values;
+};
+
+bool parse_options(const char* spec, ParsedOptions* out, std::string* err) {
+  if (!spec) return true;
+  std::string s(spec);
+  // First pass: collect rows so vector reallocation can't invalidate
+  // the c_str() pointers we hand to PJRT.
+  struct Row {
+    std::string name, type, value;
+  };
+  std::vector<Row> rows;
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t eol = s.find('\n', pos);
+    if (eol == std::string::npos) eol = s.size();
+    std::string line = s.substr(pos, eol - pos);
+    pos = eol + 1;
+    if (line.empty()) continue;
+    size_t t1 = line.find('\t');
+    size_t t2 = (t1 == std::string::npos) ? std::string::npos
+                                          : line.find('\t', t1 + 1);
+    if (t2 == std::string::npos) {
+      *err = "bad options line (want name\\ttype\\tvalue): " + line;
+      return false;
+    }
+    rows.push_back({line.substr(0, t1), line.substr(t1 + 1, t2 - t1 - 1),
+                    line.substr(t2 + 1)});
+  }
+  out->names.reserve(rows.size());
+  out->strings.reserve(rows.size());
+  out->ints.reserve(rows.size());
+  for (const Row& r : rows) {
+    out->names.push_back(r.name);
+    PJRT_NamedValue v;
+    memset(&v, 0, sizeof(v));
+    v.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    v.name = out->names.back().c_str();
+    v.name_size = r.name.size();
+    if (r.type == "s") {
+      out->strings.push_back(r.value);
+      v.type = PJRT_NamedValue_kString;
+      v.string_value = out->strings.back().c_str();
+      v.value_size = r.value.size();
+    } else if (r.type == "i") {
+      out->ints.push_back(strtoll(r.value.c_str(), nullptr, 10));
+      v.type = PJRT_NamedValue_kInt64;
+      v.int64_value = out->ints.back();
+      v.value_size = 1;
+    } else if (r.type == "b") {
+      v.type = PJRT_NamedValue_kBool;
+      v.bool_value = (r.value == "1" || r.value == "true");
+      v.value_size = 1;
+    } else {
+      *err = "bad option type (want s|i|b): " + r.type;
+      return false;
+    }
+    out->values.push_back(v);
+  }
+  return true;
+}
+
+}  // namespace
+
+namespace {
+void dbg(const char* msg) {
+  if (getenv("PB_DEBUG")) fprintf(stderr, "pb_execute: %s\n", msg), fflush(stderr);
+}
+
+void destroy_buf(const PJRT_Api* api, PJRT_Buffer* b) {
+  if (!b) return;
+  PJRT_Buffer_Destroy_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  args.buffer = b;
+  check(api, api->PJRT_Buffer_Destroy(&args));
+}
+
+// The bridge ABI carries exactly one output array; reject anything
+// else up front (a multi-output program would overflow the 1-slot
+// output list handed to Execute).
+std::string check_single_output(const PJRT_Api* api,
+                                PJRT_LoadedExecutable* exec) {
+  PJRT_LoadedExecutable_GetExecutable_Args gargs;
+  memset(&gargs, 0, sizeof(gargs));
+  gargs.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  gargs.loaded_executable = exec;
+  std::string msg = check(api, api->PJRT_LoadedExecutable_GetExecutable(&gargs));
+  if (!msg.empty()) return "GetExecutable: " + msg;
+  PJRT_Executable_NumOutputs_Args nargs;
+  memset(&nargs, 0, sizeof(nargs));
+  nargs.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  nargs.executable = gargs.executable;
+  msg = check(api, api->PJRT_Executable_NumOutputs(&nargs));
+  size_t n_out = nargs.num_outputs;
+  PJRT_Executable_Destroy_Args xdargs;
+  memset(&xdargs, 0, sizeof(xdargs));
+  xdargs.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+  xdargs.executable = gargs.executable;
+  check(api, api->PJRT_Executable_Destroy(&xdargs));
+  if (!msg.empty()) return "NumOutputs: " + msg;
+  if (n_out != 1) {
+    return "program has " + std::to_string(n_out) +
+           " outputs; the bridge ABI supports exactly 1";
+  }
+  return "";
+}
+}  // namespace
+
+extern "C" {
+
+int pb_destroy(void* ctx_v);
+
+int pb_create(const char* so_path, const char* options_spec, void** ctx_out,
+              char* err, size_t errlen) {
+  auto* ctx = new PbContext();
+  ctx->dl = dlopen(so_path, RTLD_NOW | RTLD_LOCAL);
+  if (!ctx->dl) {
+    set_err(err, errlen, std::string("dlopen failed: ") + dlerror());
+    delete ctx;
+    return -1;
+  }
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api =
+      reinterpret_cast<GetPjrtApiFn>(dlsym(ctx->dl, "GetPjrtApi"));
+  if (!get_api) {
+    set_err(err, errlen, "GetPjrtApi symbol not found");
+    dlclose(ctx->dl);
+    delete ctx;
+    return -1;
+  }
+  ctx->api = get_api();
+  if (!ctx->api) {
+    set_err(err, errlen, "GetPjrtApi returned null");
+    dlclose(ctx->dl);
+    delete ctx;
+    return -1;
+  }
+
+  PJRT_Plugin_Initialize_Args iargs;
+  memset(&iargs, 0, sizeof(iargs));
+  iargs.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  std::string msg = check(ctx->api, ctx->api->PJRT_Plugin_Initialize(&iargs));
+  if (!msg.empty()) {
+    set_err(err, errlen, "Plugin_Initialize: " + msg);
+    dlclose(ctx->dl);
+    delete ctx;
+    return -1;
+  }
+
+  ParsedOptions opts;
+  if (!parse_options(options_spec, &opts, &msg)) {
+    set_err(err, errlen, msg);
+    dlclose(ctx->dl);
+    delete ctx;
+    return -1;
+  }
+
+  PJRT_Client_Create_Args cargs;
+  memset(&cargs, 0, sizeof(cargs));
+  cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  cargs.create_options = opts.values.data();
+  cargs.num_options = opts.values.size();
+  msg = check(ctx->api, ctx->api->PJRT_Client_Create(&cargs));
+  if (!msg.empty()) {
+    set_err(err, errlen, "Client_Create: " + msg);
+    dlclose(ctx->dl);
+    delete ctx;
+    return -1;
+  }
+  ctx->client = cargs.client;
+
+  PJRT_Client_AddressableDevices_Args adargs;
+  memset(&adargs, 0, sizeof(adargs));
+  adargs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  adargs.client = ctx->client;
+  msg = check(ctx->api, ctx->api->PJRT_Client_AddressableDevices(&adargs));
+  if (!msg.empty() || adargs.num_addressable_devices == 0) {
+    set_err(err, errlen, "no addressable devices: " + msg);
+    pb_destroy(ctx);
+    return -1;
+  }
+  ctx->device = adargs.addressable_devices[0];
+  *ctx_out = ctx;
+  return 0;
+}
+
+int pb_api_version(void* ctx_v, int* major, int* minor) {
+  auto* ctx = static_cast<PbContext*>(ctx_v);
+  *major = ctx->api->pjrt_api_version.major_version;
+  *minor = ctx->api->pjrt_api_version.minor_version;
+  return 0;
+}
+
+int pb_device_count(void* ctx_v) {
+  auto* ctx = static_cast<PbContext*>(ctx_v);
+  PJRT_Client_AddressableDevices_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  args.client = ctx->client;
+  if (!check(ctx->api, ctx->api->PJRT_Client_AddressableDevices(&args))
+           .empty()) {
+    return -1;
+  }
+  return static_cast<int>(args.num_addressable_devices);
+}
+
+int pb_platform_name(void* ctx_v, char* out, size_t outlen) {
+  auto* ctx = static_cast<PbContext*>(ctx_v);
+  PJRT_Client_PlatformName_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+  args.client = ctx->client;
+  if (!check(ctx->api, ctx->api->PJRT_Client_PlatformName(&args)).empty()) {
+    return -1;
+  }
+  size_t n = args.platform_name_size < outlen - 1 ? args.platform_name_size
+                                                  : outlen - 1;
+  memcpy(out, args.platform_name, n);
+  out[n] = 0;
+  return 0;
+}
+
+int pb_compile(void* ctx_v, const char* code, size_t code_len,
+               const char* format, const char* copts, size_t copts_len,
+               void** exec_out, char* err, size_t errlen) {
+  auto* ctx = static_cast<PbContext*>(ctx_v);
+  PJRT_Program program;
+  memset(&program, 0, sizeof(program));
+  program.struct_size = PJRT_Program_STRUCT_SIZE;
+  program.code = const_cast<char*>(code);
+  program.code_size = code_len;
+  program.format = format;
+  program.format_size = strlen(format);
+
+  PJRT_Client_Compile_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  args.client = ctx->client;
+  args.program = &program;
+  args.compile_options = copts;
+  args.compile_options_size = copts_len;
+  std::string msg = check(ctx->api, ctx->api->PJRT_Client_Compile(&args));
+  if (!msg.empty()) {
+    set_err(err, errlen, "Compile: " + msg);
+    return -1;
+  }
+  // the bridge ABI carries exactly one output buffer; validate once
+  // here rather than on the per-dispatch hot path
+  msg = check_single_output(ctx->api, args.executable);
+  if (!msg.empty()) {
+    PJRT_LoadedExecutable_Destroy_Args xdargs;
+    memset(&xdargs, 0, sizeof(xdargs));
+    xdargs.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+    xdargs.executable = args.executable;
+    check(ctx->api, ctx->api->PJRT_LoadedExecutable_Destroy(&xdargs));
+    set_err(err, errlen, msg);
+    return -1;
+  }
+  *exec_out = args.executable;
+  return 0;
+}
+
+// inputs: array of PbBuffer descriptors; output written to out (u8 for
+// pred, u32 otherwise), out_bytes must match the program output size.
+int pb_execute(void* ctx_v, void* exec_v, const void** input_data,
+               const int64_t* const* input_dims, const size_t* input_ndims,
+               const int* input_dtypes, size_t n_inputs, void* out,
+               size_t out_bytes, char* err, size_t errlen) {
+  auto* ctx = static_cast<PbContext*>(ctx_v);
+  auto* exec = static_cast<PJRT_LoadedExecutable*>(exec_v);
+  const PJRT_Api* api = ctx->api;
+  PJRT_Device* device = ctx->device;
+  std::string msg;
+  dbg("got device");
+
+  // Host -> device transfers.  Everything created below is destroyed
+  // on every exit path (device memory would leak across retries
+  // otherwise).
+  std::vector<PJRT_Buffer*> in_bufs(n_inputs, nullptr);
+  PJRT_Buffer* out_buf = nullptr;
+  auto cleanup = [&]() {
+    for (PJRT_Buffer* b : in_bufs) destroy_buf(api, b);
+    destroy_buf(api, out_buf);
+  };
+  std::vector<PJRT_Event*> done_events(n_inputs);
+  for (size_t i = 0; i < n_inputs; ++i) {
+    PJRT_Client_BufferFromHostBuffer_Args bargs;
+    memset(&bargs, 0, sizeof(bargs));
+    bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    bargs.client = ctx->client;
+    bargs.data = input_data[i];
+    bargs.type = input_dtypes[i] == 1 ? PJRT_Buffer_Type_PRED
+                                      : PJRT_Buffer_Type_U32;
+    bargs.dims = input_dims[i];
+    bargs.num_dims = input_ndims[i];
+    bargs.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    bargs.device = device;
+    msg = check(api, api->PJRT_Client_BufferFromHostBuffer(&bargs));
+    if (!msg.empty()) {
+      for (size_t j = 0; j < i; ++j) {
+        PJRT_Event_Destroy_Args edargs;
+        memset(&edargs, 0, sizeof(edargs));
+        edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+        edargs.event = done_events[j];
+        api->PJRT_Event_Destroy(&edargs);
+      }
+      cleanup();
+      set_err(err, errlen, "BufferFromHostBuffer: " + msg);
+      return -1;
+    }
+    in_bufs[i] = bargs.buffer;
+    done_events[i] = bargs.done_with_host_buffer;
+    dbg("transferred input");
+  }
+  for (size_t i = 0; i < n_inputs; ++i) {
+    PJRT_Event_Await_Args eargs;
+    memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    eargs.event = done_events[i];
+    check(api, api->PJRT_Event_Await(&eargs));
+    PJRT_Event_Destroy_Args edargs;
+    memset(&edargs, 0, sizeof(edargs));
+    edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    edargs.event = done_events[i];
+    api->PJRT_Event_Destroy(&edargs);
+    dbg("input transfer event done");
+  }
+
+  // Execute on one device.
+  PJRT_ExecuteOptions options;
+  memset(&options, 0, sizeof(options));
+  options.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+  PJRT_Buffer* const* arg_list = in_bufs.data();
+  PJRT_Buffer** out_list = &out_buf;
+  PJRT_Event* done = nullptr;
+
+  PJRT_LoadedExecutable_Execute_Args xargs;
+  memset(&xargs, 0, sizeof(xargs));
+  xargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  xargs.executable = exec;
+  xargs.options = &options;
+  xargs.argument_lists = &arg_list;
+  xargs.num_devices = 1;
+  xargs.num_args = n_inputs;
+  xargs.output_lists = &out_list;
+  xargs.device_complete_events = &done;
+  xargs.execute_device = device;
+  dbg("calling Execute");
+  msg = check(api, api->PJRT_LoadedExecutable_Execute(&xargs));
+  if (!msg.empty()) {
+    cleanup();
+    set_err(err, errlen, "Execute: " + msg);
+    return -1;
+  }
+  dbg("Execute returned");
+  {
+    PJRT_Event_Await_Args eargs;
+    memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    eargs.event = done;
+    check(api, api->PJRT_Event_Await(&eargs));
+    PJRT_Event_Destroy_Args edargs;
+    memset(&edargs, 0, sizeof(edargs));
+    edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    edargs.event = done;
+    api->PJRT_Event_Destroy(&edargs);
+  }
+
+  dbg("execution event done");
+  // Device -> host.
+  PJRT_Buffer_ToHostBuffer_Args hargs;
+  memset(&hargs, 0, sizeof(hargs));
+  hargs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  hargs.src = out_buf;
+  hargs.dst = out;
+  hargs.dst_size = out_bytes;
+  msg = check(api, api->PJRT_Buffer_ToHostBuffer(&hargs));
+  if (!msg.empty()) {
+    cleanup();
+    set_err(err, errlen, "ToHostBuffer: " + msg);
+    return -1;
+  }
+  {
+    PJRT_Event_Await_Args eargs;
+    memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    eargs.event = hargs.event;
+    msg = check(api, api->PJRT_Event_Await(&eargs));
+    PJRT_Event_Destroy_Args edargs;
+    memset(&edargs, 0, sizeof(edargs));
+    edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    edargs.event = hargs.event;
+    api->PJRT_Event_Destroy(&edargs);
+    if (!msg.empty()) {
+      cleanup();
+      set_err(err, errlen, "ToHostBuffer await: " + msg);
+      return -1;
+    }
+    dbg("readback done");
+  }
+
+  cleanup();
+  return 0;
+}
+
+int pb_exec_destroy(void* ctx_v, void* exec_v) {
+  auto* ctx = static_cast<PbContext*>(ctx_v);
+  PJRT_LoadedExecutable_Destroy_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+  args.executable = static_cast<PJRT_LoadedExecutable*>(exec_v);
+  check(ctx->api, ctx->api->PJRT_LoadedExecutable_Destroy(&args));
+  return 0;
+}
+
+int pb_destroy(void* ctx_v) {
+  auto* ctx = static_cast<PbContext*>(ctx_v);
+  if (ctx->client) {
+    PJRT_Client_Destroy_Args args;
+    memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    args.client = ctx->client;
+    check(ctx->api, ctx->api->PJRT_Client_Destroy(&args));
+  }
+  if (ctx->dl) dlclose(ctx->dl);
+  delete ctx;
+  return 0;
+}
+
+}  // extern "C"

@@ -76,3 +76,67 @@ class TestNativeHash:
             assert jax_root == golden
         finally:
             use_mainnet_config()
+
+
+class TestPjrtBridge:
+    """The C++ PJRT host bridge (native/pjrt_bridge.cpp): build, load,
+    and error paths.  Creating a real client claims the TPU, so the
+    end-to-end dispatch is exercised by the demo entry
+    (`python -m prysm_tpu.native.pjrt_bridge`) and gated here behind
+    RUN_PJRT_BRIDGE_E2E=1."""
+
+    def test_builds_and_loads(self):
+        from prysm_tpu.native.pjrt_bridge import load_bridge
+
+        lib = load_bridge()
+        assert lib.pb_create is not None
+        assert lib.pb_execute is not None
+
+    def test_create_rejects_missing_plugin(self):
+        import pytest
+
+        from prysm_tpu.native.pjrt_bridge import PjrtBridgeClient
+
+        with pytest.raises(RuntimeError, match="dlopen"):
+            PjrtBridgeClient("/nonexistent/plugin.so", "")
+
+    def test_create_rejects_non_plugin_so(self):
+        import pytest
+
+        from prysm_tpu.native.pjrt_bridge import (
+            BRIDGE_LIB, PjrtBridgeClient, ensure_built,
+        )
+
+        ensure_built()
+        # the bridge library itself is a valid .so without GetPjrtApi
+        with pytest.raises(RuntimeError, match="GetPjrtApi"):
+            PjrtBridgeClient(str(BRIDGE_LIB), "")
+
+    def test_program_export_shapes(self):
+        import jax
+        import jax.numpy as jnp
+
+        from prysm_tpu.native.pjrt_bridge import export_jit_program
+
+        def fn(x, y):
+            return (x * y).sum(dtype=jnp.uint32)
+
+        a = jnp.arange(8, dtype=jnp.uint32)
+        prog = export_jit_program(fn, (a, a))
+        assert "stablehlo" in prog["mlir"] or "module" in prog["mlir"]
+        assert len(prog["inputs"]) == 2
+        assert prog["out_bytes"] == 4
+        assert len(prog["compile_options"]) > 0
+
+    def test_e2e_dispatch_if_enabled(self):
+        import os
+
+        import pytest
+
+        if os.environ.get("RUN_PJRT_BRIDGE_E2E") != "1":
+            pytest.skip("set RUN_PJRT_BRIDGE_E2E=1 for the TPU e2e path")
+        from prysm_tpu.native.pjrt_bridge import run_demo_subprocess
+
+        info = run_demo_subprocess()
+        assert info["verdict"] is True
+        assert info["device_count"] >= 1
