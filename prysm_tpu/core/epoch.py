@@ -209,6 +209,9 @@ def get_attestation_deltas(state) -> tuple[list[int], list[int]]:
 
 
 def process_rewards_and_penalties(state) -> None:
+    """Spec-shaped (naive) reward application — kept as the golden
+    model; process_epoch uses the vectorized precompute path, which is
+    differentially tested against this (tests/test_precompute.py)."""
     if get_current_epoch(state) == GENESIS_EPOCH:
         return
     rewards, penalties = get_attestation_deltas(state)
@@ -300,8 +303,10 @@ def process_final_updates(state) -> None:
 
 
 def process_epoch(state) -> None:
+    from .precompute import process_rewards_and_penalties_fast
+
     process_justification_and_finalization(state)
-    process_rewards_and_penalties(state)
+    process_rewards_and_penalties_fast(state)
     process_registry_updates(state)
     process_slashings(state)
     process_final_updates(state)

@@ -1,0 +1,189 @@
+"""Single-pass vectorized epoch processing (numpy).
+
+Reference analog: ``beacon-chain/core/epoch/precompute`` [U, SURVEY.md
+§2 "core/epoch"] — upstream computes per-validator participation flags
+in one pass and assembles rewards/penalties from them instead of
+re-scanning attestations per component.  Here the flag pass fills
+numpy bool/uint64 arrays and the delta assembly is pure array
+arithmetic, so epoch processing stays O(validators) with small
+constants at 500k-validator scale (the host-side analog of the
+device-side batching the crypto path does).
+
+Differentially tested against the naive spec-shaped implementation in
+``epoch.py`` (tests/test_precompute.py); ``process_epoch`` uses this
+path by default.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..config import beacon_config
+from .helpers import (
+    BASE_REWARDS_PER_EPOCH, get_attesting_indices, get_block_root,
+    get_block_root_at_slot, get_current_epoch, get_previous_epoch,
+    integer_squareroot,
+)
+
+_U64 = np.uint64
+
+
+@dataclass
+class EpochFlags:
+    """Per-validator participation arrays for the previous epoch."""
+
+    eff_balance: np.ndarray          # uint64 (n,)
+    active_prev: np.ndarray          # bool (n,)
+    slashed: np.ndarray              # bool (n,)
+    eligible: np.ndarray             # bool (n,)
+    src: np.ndarray                  # bool (n,) unslashed source attester
+    tgt: np.ndarray                  # bool (n,) unslashed target attester
+    head: np.ndarray                 # bool (n,) unslashed head attester
+    incl_delay: np.ndarray           # uint64 (n,) min inclusion delay
+    incl_proposer: np.ndarray        # int64 (n,) proposer of that att
+    total_active: int                # total active balance (gwei)
+    src_balance: int
+    tgt_balance: int
+    head_balance: int
+
+
+def build_flags(state) -> EpochFlags:
+    cfg = beacon_config()
+    n = len(state.validators)
+    previous_epoch = get_previous_epoch(state)
+
+    eff = np.fromiter((v.effective_balance for v in state.validators),
+                      dtype=_U64, count=n)
+    act_prev = np.fromiter(
+        (v.activation_epoch <= previous_epoch < v.exit_epoch
+         for v in state.validators), dtype=bool, count=n)
+    act_curr = np.fromiter(
+        (v.activation_epoch <= previous_epoch + 1 < v.exit_epoch
+         for v in state.validators), dtype=bool, count=n)
+    slashed = np.fromiter((v.slashed for v in state.validators),
+                          dtype=bool, count=n)
+    withdrawable = np.fromiter(
+        (v.withdrawable_epoch for v in state.validators),
+        dtype=_U64, count=n)
+    eligible = act_prev | (slashed
+                           & (previous_epoch + 1 < withdrawable))
+
+    # current epoch here == previous_epoch + 1 except at genesis where
+    # both are 0 — match get_total_active_balance's "current" semantics
+    current_epoch = get_current_epoch(state)
+    if current_epoch == previous_epoch:
+        act_for_total = act_prev
+    else:
+        act_for_total = act_curr
+    total_active = max(int(eff[act_for_total].sum()),
+                       cfg.effective_balance_increment)
+
+    src = np.zeros(n, dtype=bool)
+    tgt = np.zeros(n, dtype=bool)
+    head = np.zeros(n, dtype=bool)
+    incl_delay = np.full(n, np.iinfo(np.uint64).max, dtype=_U64)
+    incl_proposer = np.full(n, -1, dtype=np.int64)
+
+    target_root = get_block_root(state, previous_epoch)
+    for a in state.previous_epoch_attestations:
+        idx = np.fromiter(
+            get_attesting_indices(state, a.data, a.aggregation_bits),
+            dtype=np.int64)
+        if idx.size == 0:
+            continue
+        src[idx] = True
+        # min-inclusion-delay attestation per validator; list order
+        # breaks ties (Python min picks the first minimum)
+        delay = int(a.inclusion_delay)
+        better = idx[delay < incl_delay[idx]]
+        incl_delay[better] = delay
+        incl_proposer[better] = int(a.proposer_index)
+        if a.data.target.root == target_root:
+            tgt[idx] = True
+            if (a.data.beacon_block_root
+                    == get_block_root_at_slot(state, a.data.slot)):
+                head[idx] = True
+
+    unsl = ~slashed
+    src &= unsl
+    tgt &= unsl
+    head &= unsl
+
+    inc = cfg.effective_balance_increment
+
+    def bal(mask):
+        return max(int(eff[mask].sum()), inc)
+
+    return EpochFlags(
+        eff_balance=eff, active_prev=act_prev, slashed=slashed,
+        eligible=eligible, src=src, tgt=tgt, head=head,
+        incl_delay=incl_delay, incl_proposer=incl_proposer,
+        total_active=total_active, src_balance=bal(src),
+        tgt_balance=bal(tgt), head_balance=bal(head))
+
+
+def attestation_deltas(state, flags: EpochFlags | None = None
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized get_attestation_deltas: uint64 (rewards, penalties)."""
+    cfg = beacon_config()
+    f = flags or build_flags(state)
+    n = f.eff_balance.size
+    rewards = np.zeros(n, dtype=_U64)
+    penalties = np.zeros(n, dtype=_U64)
+
+    total = f.total_active
+    sqrt_total = integer_squareroot(total)
+    base = (f.eff_balance * _U64(cfg.base_reward_factor)
+            // _U64(sqrt_total) // _U64(BASE_REWARDS_PER_EPOCH))
+
+    finality_delay = (get_previous_epoch(state)
+                      - state.finalized_checkpoint.epoch)
+    in_leak = finality_delay > cfg.min_epochs_to_inactivity_penalty
+    inc = _U64(cfg.effective_balance_increment)
+    total_units = _U64(total) // inc
+
+    for mask, attesting_balance in ((f.src, f.src_balance),
+                                    (f.tgt, f.tgt_balance),
+                                    (f.head, f.head_balance)):
+        got = f.eligible & mask
+        missed = f.eligible & ~mask
+        if in_leak:
+            rewards[got] += base[got]
+        else:
+            units = _U64(attesting_balance) // inc
+            rewards[got] += base[got] * units // total_units
+        penalties[missed] += base[missed]
+
+    # inclusion delay micro-rewards (source attesters only; the flag
+    # pass recorded the min-delay attestation + its proposer)
+    srcm = f.src
+    prop_reward = base // _U64(cfg.proposer_reward_quotient)
+    np.add.at(rewards, f.incl_proposer[srcm], prop_reward[srcm])
+    max_attester = base[srcm] - prop_reward[srcm]
+    rewards[srcm] += max_attester // f.incl_delay[srcm]
+
+    if in_leak:
+        el = f.eligible
+        penalties[el] += (_U64(BASE_REWARDS_PER_EPOCH) * base[el]
+                          - base[el] // _U64(cfg.proposer_reward_quotient))
+        lag = f.eligible & ~f.tgt
+        penalties[lag] += (f.eff_balance[lag] * _U64(finality_delay)
+                           // _U64(cfg.inactivity_penalty_quotient))
+
+    return rewards, penalties
+
+
+def process_rewards_and_penalties_fast(state) -> None:
+    """Vectorized drop-in for epoch.process_rewards_and_penalties."""
+    from .helpers import GENESIS_EPOCH
+
+    if get_current_epoch(state) == GENESIS_EPOCH:
+        return
+    rewards, penalties = attestation_deltas(state)
+    bal = np.fromiter((int(b) for b in state.balances), dtype=np.int64,
+                      count=len(state.balances))
+    out = bal + rewards.astype(np.int64)
+    out = np.maximum(out - penalties.astype(np.int64), 0)
+    state.balances[:] = [int(b) for b in out]
