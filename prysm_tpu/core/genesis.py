@@ -1,0 +1,120 @@
+"""Genesis state construction from eth1 deposits.
+
+Reference analog: ``beacon-chain/core/blocks`` genesis helpers /
+upstream spec's ``initialize_beacon_state_from_eth1`` +
+``is_valid_genesis_state`` path used by the reference's
+``beacon-chain/blockchain`` on chain start [U, SURVEY.md §2
+"core/transition", §3.1].  The testing fixture
+(testing/util.deterministic_genesis_state) fabricates an
+already-active registry; this module is the real path: replay the
+deposit contract's log through ``process_deposit`` semantics, apply
+the genesis activation rule, and gate on the spec's validity
+predicate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..config import beacon_config
+from ..proto import (
+    BeaconBlockHeader, DepositData, Eth1Data, Fork, active_types,
+)
+from .deposits import DepositTree
+from .transition import process_deposit
+
+
+def initialize_beacon_state_from_eth1(eth1_block_hash: bytes,
+                                      eth1_timestamp: int,
+                                      deposits,
+                                      types=None):
+    """Spec-shaped genesis construction: start from an empty state
+    anchored to the eth1 block, apply every deposit (with proofs
+    against the incrementally-built deposit tree), then activate
+    validators that reached MAX_EFFECTIVE_BALANCE."""
+    types = types or active_types()
+    cfg = beacon_config()
+
+    state = types.BeaconState(
+        genesis_time=(eth1_timestamp + cfg.genesis_delay),
+        fork=Fork(previous_version=cfg.genesis_fork_version,
+                  current_version=cfg.genesis_fork_version,
+                  epoch=0),
+        latest_block_header=BeaconBlockHeader(
+            body_root=types.BeaconBlockBody.hash_tree_root(
+                types.BeaconBlockBody())),
+        eth1_data=Eth1Data(deposit_root=b"\x00" * 32,
+                           deposit_count=len(deposits),
+                           block_hash=eth1_block_hash),
+        randao_mixes=[eth1_block_hash] * cfg.epochs_per_historical_vector,
+    )
+
+    # replay deposits through the block-processing op; per the spec the
+    # i-th deposit's proof verifies against the PARTIAL contract tree
+    # holding leaves[:i+1], so rebuild the root incrementally
+    tree = DepositTree()
+    for deposit in deposits:
+        tree.push(DepositData.hash_tree_root(deposit.data))
+        state.eth1_data.deposit_root = tree.root()
+        process_deposit(state, deposit)
+
+    # genesis activations: full-balance validators become active at
+    # epoch 0 immediately
+    for index, v in enumerate(state.validators):
+        balance = state.balances[index]
+        v.effective_balance = min(
+            balance - balance % cfg.effective_balance_increment,
+            cfg.max_effective_balance)
+        if v.effective_balance == cfg.max_effective_balance:
+            v.activation_eligibility_epoch = 0
+            v.activation_epoch = 0
+
+    from .. import ssz
+    from ..proto import VALIDATOR_REGISTRY_LIMIT, Validator
+
+    state.genesis_validators_root = ssz.List(
+        Validator, VALIDATOR_REGISTRY_LIMIT).hash_tree_root(
+            state.validators)
+    return state
+
+
+def is_valid_genesis_state(state) -> bool:
+    """Spec predicate: enough active validators and past the minimum
+    genesis time."""
+    cfg = beacon_config()
+    if state.genesis_time < cfg.min_genesis_time:
+        return False
+    active = sum(1 for v in state.validators
+                 if v.activation_epoch <= 0 < v.exit_epoch)
+    return active >= cfg.min_genesis_active_validator_count
+
+
+def genesis_deposits(n: int, amount: int | None = None,
+                     start_index: int = 0):
+    """Build n valid signed deposits (deterministic keys) with proofs
+    — the spec's DepositTestCase analog used by genesis tests and the
+    e2e harness."""
+    from ..crypto.bls import bls
+    from ..proto import Deposit, DepositMessage
+    from .helpers import compute_domain, compute_signing_root
+
+    cfg = beacon_config()
+    amount = amount or cfg.max_effective_balance
+    tree = DepositTree()
+    out = []
+    for i in range(n):
+        sk, pk = bls.deterministic_keypair(start_index + i)
+        pkb = pk.to_bytes()
+        wc = b"\x00" + hashlib.sha256(pkb).digest()[1:]
+        msg = DepositMessage(pubkey=pkb, withdrawal_credentials=wc,
+                             amount=amount)
+        domain = compute_domain(cfg.domain_deposit)
+        root = compute_signing_root(msg, domain)
+        data = DepositData(pubkey=pkb, withdrawal_credentials=wc,
+                           amount=amount,
+                           signature=sk.sign(root).to_bytes())
+        # the i-th proof is against the partial tree with i+1 leaves —
+        # the shape initialize_beacon_state_from_eth1 verifies
+        tree.push(DepositData.hash_tree_root(data))
+        out.append(Deposit(proof=tree.proof(i), data=data))
+    return out

@@ -1,0 +1,105 @@
+"""Genesis-from-eth1 construction (core/genesis.py): deposit replay
+with partial-tree proofs, activation rule, validity predicate.
+"""
+
+import pytest
+
+from prysm_tpu.config import (
+    MINIMAL_CONFIG, set_features, use_minimal_config,
+)
+from prysm_tpu.core.genesis import (
+    genesis_deposits, initialize_beacon_state_from_eth1,
+    is_valid_genesis_state,
+)
+from prysm_tpu.proto import build_types
+
+ETH1_HASH = b"\x42" * 32
+
+
+@pytest.fixture(scope="module")
+def genesis_setup():
+    use_minimal_config()
+    set_features(bls_implementation="pure")
+    types = build_types(MINIMAL_CONFIG)
+    deposits = genesis_deposits(4)
+    state = initialize_beacon_state_from_eth1(
+        ETH1_HASH, MINIMAL_CONFIG.min_genesis_time, deposits, types)
+    return state, deposits, types
+
+
+class TestGenesisFromEth1:
+    def test_all_deposits_become_validators(self, genesis_setup):
+        state, deposits, _ = genesis_setup
+        assert len(state.validators) == 4
+        assert state.eth1_deposit_index == 4
+        assert state.eth1_data.deposit_count == 4
+
+    def test_full_balance_validators_active_at_genesis(self, genesis_setup):
+        state, _, _ = genesis_setup
+        for v in state.validators:
+            assert v.activation_epoch == 0
+            assert v.activation_eligibility_epoch == 0
+            assert v.effective_balance == (
+                MINIMAL_CONFIG.max_effective_balance)
+
+    def test_genesis_validators_root_set(self, genesis_setup):
+        state, _, _ = genesis_setup
+        assert state.genesis_validators_root != b"\x00" * 32
+
+    def test_genesis_time_includes_delay(self, genesis_setup):
+        state, _, _ = genesis_setup
+        assert state.genesis_time == (MINIMAL_CONFIG.min_genesis_time
+                                      + MINIMAL_CONFIG.genesis_delay)
+
+    def test_invalid_deposit_signature_skipped(self):
+        """A deposit with a corrupted signature is skipped (no
+        validator), matching process_deposit's proof-of-possession
+        rule — but its proof must still verify."""
+        use_minimal_config()
+        set_features(bls_implementation="pure")
+        types = build_types(MINIMAL_CONFIG)
+        deposits = genesis_deposits(3)
+        bad_sig = bytearray(deposits[1].data.signature)
+        bad_sig[0] ^= 0xFF
+        deposits[1].data.signature = bytes(bad_sig)
+        # re-derive proofs: DepositData changed, so the tree changed
+        from prysm_tpu.core.deposits import DepositTree
+        from prysm_tpu.proto import DepositData
+
+        tree = DepositTree()
+        for i, d in enumerate(deposits):
+            tree.push(DepositData.hash_tree_root(d.data))
+            d.proof = tree.proof(i)
+        state = initialize_beacon_state_from_eth1(
+            ETH1_HASH, MINIMAL_CONFIG.min_genesis_time, deposits, types)
+        assert len(state.validators) == 2
+        assert state.eth1_deposit_index == 3
+
+    def test_tampered_proof_rejected(self, genesis_setup):
+        from prysm_tpu.core.transition import StateTransitionError
+
+        use_minimal_config()
+        types = build_types(MINIMAL_CONFIG)
+        deposits = genesis_deposits(2)
+        bad = bytearray(deposits[0].proof[0])
+        bad[0] ^= 1
+        deposits[0].proof[0] = bytes(bad)
+        with pytest.raises(StateTransitionError):
+            initialize_beacon_state_from_eth1(
+                ETH1_HASH, MINIMAL_CONFIG.min_genesis_time, deposits, types)
+
+    def test_validity_predicate(self, genesis_setup):
+        state, _, types = genesis_setup
+        # 4 active < minimal's min_genesis_active_validator_count (64)
+        assert not is_valid_genesis_state(state)
+        # pad the registry with active validators to cross the bar
+        big = state.copy()
+        need = MINIMAL_CONFIG.min_genesis_active_validator_count
+        proto = state.validators[0]
+        while len(big.validators) < need:
+            big.validators.append(proto.copy())
+            big.balances.append(MINIMAL_CONFIG.max_effective_balance)
+        assert is_valid_genesis_state(big)
+        # too-early genesis time fails
+        big.genesis_time = MINIMAL_CONFIG.min_genesis_time - 1
+        assert not is_valid_genesis_state(big)
