@@ -3,9 +3,10 @@
 Reference analog: ``validator/keymanager`` (local keystores /
 derived / remote) [U, SURVEY.md §2 "validator client"].  The local
 manager holds secret keys in memory; deterministic derivation mirrors
-the testing/util pattern (the e2e harness's interop keys).  EIP-2335
-keystore files are out of scope offline — the seam (``sign`` by
-pubkey) matches, which is what the client codes against.
+the testing/util pattern (the e2e harness's interop keys); EIP-2335
+encrypted keystore files round-trip through ``keystore.py``
+(import_keystores / export_keystores — the reference's imported
+keymanager + accounts import/export flow).
 """
 
 from __future__ import annotations
@@ -43,3 +44,28 @@ class KeyManager:
         if sk is None:
             raise KeyError("unknown pubkey")
         return sk.sign(signing_root)
+
+    # --- EIP-2335 keystores (accounts import/export analog) ---------------
+
+    def import_keystores(self, dirpath: str, password: str) -> list[bytes]:
+        """Load every keystore-*.json in ``dirpath``; returns the
+        imported pubkeys.  Wrong password raises KeystoreError."""
+        from .keystore import decrypt_keystore, load_keystores
+
+        imported = []
+        for ks in load_keystores(dirpath):
+            secret = decrypt_keystore(ks, password)
+            imported.append(self.add(bls.SecretKey.from_bytes(secret)))
+        return imported
+
+    def export_keystores(self, dirpath: str, password: str,
+                         kdf: str = "scrypt") -> list[str]:
+        """Encrypt every held key into ``dirpath``; returns paths."""
+        from .keystore import encrypt_keystore, save_keystore
+
+        paths = []
+        for pk, sk in self._keys.items():
+            ks = encrypt_keystore(sk.to_bytes(), password, kdf=kdf,
+                                  pubkey=pk)
+            paths.append(save_keystore(ks, dirpath))
+        return paths

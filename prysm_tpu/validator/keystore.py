@@ -1,0 +1,230 @@
+"""EIP-2335 BLS keystores (scrypt / pbkdf2 + AES-128-CTR).
+
+Reference analog: ``validator/keymanager`` local keystores
+(``direct``/``imported`` keymanager) [U, SURVEY.md §2 "validator
+client"] — encrypted-at-rest validator keys, loaded at startup with a
+wallet password.
+
+Everything here is stdlib: ``hashlib.scrypt`` / ``pbkdf2_hmac`` for
+the KDF, ``unicodedata`` for EIP-2335 password normalization (NFKD +
+control-code stripping), and a self-contained FIPS-197 AES-128
+implementation for the CTR cipher (no ``cryptography`` wheel in this
+image; encrypt-only — CTR decryption IS encryption of the counter
+stream).  The AES core is tested against the FIPS-197 appendix
+example; keystore round-trips cover both KDFs (the official EIP test
+vectors are not fetchable offline — noted per SURVEY §4 testing
+implications).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import unicodedata
+import uuid as uuid_mod
+
+# --- AES-128 (FIPS-197), encrypt-only ---------------------------------------
+
+_SBOX = [
+    0x63, 0x7c, 0x77, 0x7b, 0xf2, 0x6b, 0x6f, 0xc5,
+    0x30, 0x01, 0x67, 0x2b, 0xfe, 0xd7, 0xab, 0x76,
+    0xca, 0x82, 0xc9, 0x7d, 0xfa, 0x59, 0x47, 0xf0,
+    0xad, 0xd4, 0xa2, 0xaf, 0x9c, 0xa4, 0x72, 0xc0,
+    0xb7, 0xfd, 0x93, 0x26, 0x36, 0x3f, 0xf7, 0xcc,
+    0x34, 0xa5, 0xe5, 0xf1, 0x71, 0xd8, 0x31, 0x15,
+    0x04, 0xc7, 0x23, 0xc3, 0x18, 0x96, 0x05, 0x9a,
+    0x07, 0x12, 0x80, 0xe2, 0xeb, 0x27, 0xb2, 0x75,
+    0x09, 0x83, 0x2c, 0x1a, 0x1b, 0x6e, 0x5a, 0xa0,
+    0x52, 0x3b, 0xd6, 0xb3, 0x29, 0xe3, 0x2f, 0x84,
+    0x53, 0xd1, 0x00, 0xed, 0x20, 0xfc, 0xb1, 0x5b,
+    0x6a, 0xcb, 0xbe, 0x39, 0x4a, 0x4c, 0x58, 0xcf,
+    0xd0, 0xef, 0xaa, 0xfb, 0x43, 0x4d, 0x33, 0x85,
+    0x45, 0xf9, 0x02, 0x7f, 0x50, 0x3c, 0x9f, 0xa8,
+    0x51, 0xa3, 0x40, 0x8f, 0x92, 0x9d, 0x38, 0xf5,
+    0xbc, 0xb6, 0xda, 0x21, 0x10, 0xff, 0xf3, 0xd2,
+    0xcd, 0x0c, 0x13, 0xec, 0x5f, 0x97, 0x44, 0x17,
+    0xc4, 0xa7, 0x7e, 0x3d, 0x64, 0x5d, 0x19, 0x73,
+    0x60, 0x81, 0x4f, 0xdc, 0x22, 0x2a, 0x90, 0x88,
+    0x46, 0xee, 0xb8, 0x14, 0xde, 0x5e, 0x0b, 0xdb,
+    0xe0, 0x32, 0x3a, 0x0a, 0x49, 0x06, 0x24, 0x5c,
+    0xc2, 0xd3, 0xac, 0x62, 0x91, 0x95, 0xe4, 0x79,
+    0xe7, 0xc8, 0x37, 0x6d, 0x8d, 0xd5, 0x4e, 0xa9,
+    0x6c, 0x56, 0xf4, 0xea, 0x65, 0x7a, 0xae, 0x08,
+    0xba, 0x78, 0x25, 0x2e, 0x1c, 0xa6, 0xb4, 0xc6,
+    0xe8, 0xdd, 0x74, 0x1f, 0x4b, 0xbd, 0x8b, 0x8a,
+    0x70, 0x3e, 0xb5, 0x66, 0x48, 0x03, 0xf6, 0x0e,
+    0x61, 0x35, 0x57, 0xb9, 0x86, 0xc1, 0x1d, 0x9e,
+    0xe1, 0xf8, 0x98, 0x11, 0x69, 0xd9, 0x8e, 0x94,
+    0x9b, 0x1e, 0x87, 0xe9, 0xce, 0x55, 0x28, 0xdf,
+    0x8c, 0xa1, 0x89, 0x0d, 0xbf, 0xe6, 0x42, 0x68,
+    0x41, 0x99, 0x2d, 0x0f, 0xb0, 0x54, 0xbb, 0x16,
+]
+
+_RCON = [0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1b, 0x36]
+
+
+def _xtime(a: int) -> int:
+    a <<= 1
+    if a & 0x100:
+        a ^= 0x11b
+    return a & 0xff
+
+
+def _expand_key(key: bytes) -> list[list[int]]:
+    """128-bit key -> 11 round keys (each 16 ints)."""
+    w = [list(key[i:i + 4]) for i in range(0, 16, 4)]
+    for i in range(4, 44):
+        t = list(w[i - 1])
+        if i % 4 == 0:
+            t = t[1:] + t[:1]
+            t = [_SBOX[b] for b in t]
+            t[0] ^= _RCON[i // 4 - 1]
+        w.append([a ^ b for a, b in zip(w[i - 4], t)])
+    return [sum(w[4 * r:4 * r + 4], []) for r in range(11)]
+
+
+def _aes128_encrypt_block(rk: list[list[int]], block: bytes) -> bytes:
+    s = [b ^ k for b, k in zip(block, rk[0])]
+    for rnd in range(1, 11):
+        s = [_SBOX[b] for b in s]
+        # ShiftRows on column-major state: byte i sits at row i%4,
+        # col i//4; row r rotates left by r columns
+        s = [s[(i + 4 * (i % 4)) % 16] for i in range(16)]
+        if rnd != 10:
+            t = []
+            for c in range(4):
+                col = s[4 * c:4 * c + 4]
+                t += [
+                    _xtime(col[0]) ^ _xtime(col[1]) ^ col[1] ^ col[2]
+                    ^ col[3],
+                    col[0] ^ _xtime(col[1]) ^ _xtime(col[2]) ^ col[2]
+                    ^ col[3],
+                    col[0] ^ col[1] ^ _xtime(col[2]) ^ _xtime(col[3])
+                    ^ col[3],
+                    _xtime(col[0]) ^ col[0] ^ col[1] ^ col[2]
+                    ^ _xtime(col[3]),
+                ]
+            s = t
+        s = [b ^ k for b, k in zip(s, rk[rnd])]
+    return bytes(s)
+
+
+def aes128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    """AES-128-CTR keystream xor (symmetric: encrypts and decrypts)."""
+    if len(key) != 16 or len(iv) != 16:
+        raise ValueError("aes-128-ctr needs 16-byte key and iv")
+    rk = _expand_key(key)
+    out = bytearray()
+    counter = int.from_bytes(iv, "big")
+    for off in range(0, len(data), 16):
+        stream = _aes128_encrypt_block(
+            rk, counter.to_bytes(16, "big"))
+        chunk = data[off:off + 16]
+        out += bytes(a ^ b for a, b in zip(chunk, stream))
+        counter = (counter + 1) % (1 << 128)
+    return bytes(out)
+
+
+# --- EIP-2335 keystore ------------------------------------------------------
+
+
+def _normalize_password(password: str) -> bytes:
+    """EIP-2335: NFKD normalize, strip C0/C1/DEL control codes."""
+    norm = unicodedata.normalize("NFKD", password)
+    stripped = "".join(
+        c for c in norm
+        if not (ord(c) < 0x20 or 0x7f <= ord(c) < 0xa0))
+    return stripped.encode("utf-8")
+
+
+def _kdf(password: bytes, params: dict, function: str) -> bytes:
+    salt = bytes.fromhex(params["salt"])
+    if function == "scrypt":
+        return hashlib.scrypt(
+            password, salt=salt, n=params["n"], r=params["r"],
+            p=params["p"], dklen=params["dklen"], maxmem=2 ** 31 - 1)
+    if function == "pbkdf2":
+        if params.get("prf", "hmac-sha256") != "hmac-sha256":
+            raise ValueError("unsupported prf")
+        return hashlib.pbkdf2_hmac(
+            "sha256", password, salt, params["c"], params["dklen"])
+    raise ValueError(f"unsupported kdf {function!r}")
+
+
+class KeystoreError(Exception):
+    pass
+
+
+def encrypt_keystore(secret: bytes, password: str, *,
+                     kdf: str = "scrypt", path: str = "",
+                     pubkey: bytes | None = None,
+                     description: str = "") -> dict:
+    """secret (32-byte BLS sk, big-endian) -> EIP-2335 v4 JSON dict."""
+    salt = os.urandom(32)
+    iv = os.urandom(16)
+    pw = _normalize_password(password)
+    if kdf == "scrypt":
+        kdf_params = {"dklen": 32, "n": 262144, "r": 8, "p": 1,
+                      "salt": salt.hex()}
+    elif kdf == "pbkdf2":
+        kdf_params = {"dklen": 32, "c": 262144, "prf": "hmac-sha256",
+                      "salt": salt.hex()}
+    else:
+        raise ValueError(f"unsupported kdf {kdf!r}")
+    dk = _kdf(pw, kdf_params, kdf)
+    cipher_msg = aes128_ctr(dk[:16], iv, secret)
+    checksum = hashlib.sha256(dk[16:32] + cipher_msg).digest()
+    return {
+        "crypto": {
+            "kdf": {"function": kdf, "params": kdf_params,
+                    "message": ""},
+            "checksum": {"function": "sha256", "params": {},
+                         "message": checksum.hex()},
+            "cipher": {"function": "aes-128-ctr",
+                       "params": {"iv": iv.hex()},
+                       "message": cipher_msg.hex()},
+        },
+        "description": description,
+        "pubkey": pubkey.hex() if pubkey else "",
+        "path": path,
+        "uuid": str(uuid_mod.uuid4()),
+        "version": 4,
+    }
+
+
+def decrypt_keystore(keystore: dict, password: str) -> bytes:
+    """EIP-2335 JSON dict -> 32-byte secret; raises KeystoreError on a
+    wrong password (checksum mismatch) or malformed input."""
+    if keystore.get("version") != 4:
+        raise KeystoreError("only EIP-2335 version 4 supported")
+    crypto = keystore["crypto"]
+    pw = _normalize_password(password)
+    dk = _kdf(pw, crypto["kdf"]["params"], crypto["kdf"]["function"])
+    cipher_msg = bytes.fromhex(crypto["cipher"]["message"])
+    checksum = hashlib.sha256(dk[16:32] + cipher_msg).digest()
+    if checksum.hex() != crypto["checksum"]["message"]:
+        raise KeystoreError("checksum mismatch (wrong password?)")
+    if crypto["cipher"]["function"] != "aes-128-ctr":
+        raise KeystoreError("unsupported cipher")
+    iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+    return aes128_ctr(dk[:16], iv, cipher_msg)
+
+
+def save_keystore(keystore: dict, dirpath: str) -> str:
+    """Write with the upstream naming convention; returns the path."""
+    name = "keystore-%s.json" % keystore["uuid"]
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, name)
+    with open(path, "w") as f:
+        json.dump(keystore, f, indent=2)
+    return path
+
+
+def load_keystores(dirpath: str) -> list[dict]:
+    out = []
+    for name in sorted(os.listdir(dirpath)):
+        if name.startswith("keystore-") and name.endswith(".json"):
+            with open(os.path.join(dirpath, name)) as f:
+                out.append(json.load(f))
+    return out

@@ -1,0 +1,82 @@
+"""EIP-2335 keystores: AES core vs FIPS-197, KDF round-trips,
+password normalization, KeyManager import/export.
+"""
+
+import pytest
+
+from prysm_tpu.crypto.bls import bls
+from prysm_tpu.validator.keymanager import KeyManager
+from prysm_tpu.validator.keystore import (
+    KeystoreError, _aes128_encrypt_block, _expand_key,
+    _normalize_password, aes128_ctr, decrypt_keystore,
+    encrypt_keystore,
+)
+
+PASSWORD = "\U0001d531\U0001d522\U0001d530\U0001d531password\U0001f511"
+
+
+class TestAesCore:
+    def test_fips_197_appendix_c1(self):
+        """The FIPS-197 AES-128 example vector."""
+        key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+        pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+        ct = _aes128_encrypt_block(_expand_key(key), pt)
+        assert ct.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+
+    def test_ctr_symmetric(self):
+        key, iv = b"k" * 16, b"\x00" * 15 + b"\xff"  # counter carries
+        data = bytes(range(50))
+        enc = aes128_ctr(key, iv, data)
+        assert enc != data
+        assert aes128_ctr(key, iv, enc) == data
+
+
+class TestKeystore:
+    @pytest.mark.parametrize("kdf", ["scrypt", "pbkdf2"])
+    def test_roundtrip(self, kdf):
+        sk = bytes.fromhex("25295f0d1d592a90b333e26e85149708208e9f8e8bc18f6c77bd62f8ad7a6866")
+        ks = encrypt_keystore(sk, PASSWORD, kdf=kdf,
+                              path="m/12381/3600/0/0/0")
+        assert ks["version"] == 4
+        assert decrypt_keystore(ks, PASSWORD) == sk
+
+    def test_wrong_password_rejected(self):
+        ks = encrypt_keystore(b"\x11" * 32, PASSWORD, kdf="pbkdf2")
+        with pytest.raises(KeystoreError, match="checksum"):
+            decrypt_keystore(ks, PASSWORD + "x")
+
+    def test_password_normalization(self):
+        """EIP-2335: control codes stripped, NFKD applied."""
+        assert _normalize_password("pass\x00word\x7f") == b"password"
+        # NFKD decomposes the ligature
+        assert _normalize_password("ﬁsh") == b"fish"
+
+    def test_tampered_ciphertext_rejected(self):
+        ks = encrypt_keystore(b"\x22" * 32, PASSWORD, kdf="pbkdf2")
+        msg = bytearray.fromhex(ks["crypto"]["cipher"]["message"])
+        msg[0] ^= 1
+        ks["crypto"]["cipher"]["message"] = bytes(msg).hex()
+        with pytest.raises(KeystoreError):
+            decrypt_keystore(ks, PASSWORD)
+
+
+class TestKeyManagerIntegration:
+    def test_export_import_roundtrip(self, tmp_path):
+        km = KeyManager.deterministic(3, offset=9000)
+        paths = km.export_keystores(str(tmp_path), PASSWORD,
+                                    kdf="pbkdf2")
+        assert len(paths) == 3
+
+        km2 = KeyManager()
+        imported = km2.import_keystores(str(tmp_path), PASSWORD)
+        assert sorted(imported) == sorted(km.pubkeys())
+        # imported keys actually sign
+        root = b"\x37" * 32
+        pk = imported[0]
+        assert km2.sign(pk, root).to_bytes() == km.sign(pk, root).to_bytes()
+
+    def test_import_wrong_password(self, tmp_path):
+        km = KeyManager.deterministic(1, offset=9100)
+        km.export_keystores(str(tmp_path), PASSWORD, kdf="pbkdf2")
+        with pytest.raises(KeystoreError):
+            KeyManager().import_keystores(str(tmp_path), "nope")
