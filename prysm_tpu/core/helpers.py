@@ -223,6 +223,21 @@ def get_committee_count_per_slot(state, epoch: int, cfg=None) -> int:
         active // cfg.slots_per_epoch // cfg.target_committee_size))
 
 
+def compute_subnet_for_attestation(state, slot: int, committee_index: int,
+                                   cfg=None) -> int:
+    """Gossip subnet for a (slot, committee) — the reference's
+    helpers.ComputeSubnetForAttestation feeding the
+    beacon_attestation_{subnet} topics."""
+    cfg = cfg or beacon_config()
+    committees_per_slot = get_committee_count_per_slot(
+        state, compute_epoch_at_slot(slot, cfg), cfg)
+    slots_since_epoch_start = slot % cfg.slots_per_epoch
+    committees_since_epoch_start = (committees_per_slot
+                                    * slots_since_epoch_start)
+    return ((committees_since_epoch_start + committee_index)
+            % cfg.attestation_subnet_count)
+
+
 def get_beacon_committee(state, slot: int, index: int, cfg=None
                          ) -> list[int]:
     """Committee lookup through the epoch-level committee cache

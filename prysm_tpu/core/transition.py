@@ -135,13 +135,20 @@ def _xor32(a: bytes, b: bytes) -> bytes:
     return bytes(x ^ y for x, y in zip(a, b))
 
 
+def eth1_data_will_flip(state, vote) -> bool:
+    """Would appending ``vote`` to the state's eth1_data_votes cross
+    the majority threshold?  Single source of truth for the flip rule
+    — block production (rpc/api) uses it to pick which eth1_data its
+    deposits must match."""
+    period_len = beacon_config().slots_per_eth1_voting_period()
+    count = sum(1 for v in state.eth1_data_votes if v == vote) + 1
+    return count * 2 > period_len
+
+
 def process_eth1_data(state, body, types) -> None:
-    cfg = beacon_config()
-    state.eth1_data_votes.append(body.eth1_data)
-    period_len = cfg.slots_per_eth1_voting_period()
-    votes = [v for v in state.eth1_data_votes if v == body.eth1_data]
-    if len(votes) * 2 > period_len:
+    if eth1_data_will_flip(state, body.eth1_data):
         state.eth1_data = body.eth1_data
+    state.eth1_data_votes.append(body.eth1_data)
 
 
 def process_proposer_slashing(state, slashing) -> None:

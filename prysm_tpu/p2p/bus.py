@@ -27,6 +27,13 @@ TOPIC_EXIT = "voluntary_exit"
 TOPIC_SLASHING = "attester_slashing"
 
 
+def attestation_subnet_topic(subnet: int) -> str:
+    """Per-subnet unaggregated-attestation topic — the reference's
+    ``beacon_attestation_{subnet}`` forkdigest-namespaced topics [U,
+    SURVEY.md §2 "p2p"]."""
+    return f"{TOPIC_ATTESTATION}_{subnet}"
+
+
 class Verdict(Enum):
     ACCEPT = "accept"
     IGNORE = "ignore"

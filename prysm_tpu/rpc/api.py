@@ -134,13 +134,30 @@ class ValidatorAPI:
                 atts.append(a)
         atts = atts[:cfg.max_attestations]
 
-        body = types.BeaconBlockBody(
-            randao_reveal=randao_reveal,
-            eth1_data=Eth1Data(
+        # eth1 data: follow the powchain voting algorithm when the node
+        # has an eth1 follower, else carry the state's data forward
+        powchain = getattr(self.node, "powchain", None)
+        if powchain is not None:
+            from ..core.transition import eth1_data_will_flip
+
+            eth1_vote = powchain.get_eth1_vote(work)
+            # deposits must match the eth1_data in effect AFTER this
+            # block's vote is counted (process_eth1_data may flip it)
+            effective = (eth1_vote if eth1_data_will_flip(work, eth1_vote)
+                         else work.eth1_data)
+            deposits = powchain.deposits_for_inclusion(work, effective)
+        else:
+            eth1_vote = Eth1Data(
                 deposit_root=work.eth1_data.deposit_root,
                 deposit_count=work.eth1_data.deposit_count,
-                block_hash=work.eth1_data.block_hash),
+                block_hash=work.eth1_data.block_hash)
+            deposits = []
+
+        body = types.BeaconBlockBody(
+            randao_reveal=randao_reveal,
+            eth1_data=eth1_vote,
             graffiti=graffiti,
+            deposits=deposits,
             attestations=atts,
             proposer_slashings=self.node.slashing_pool
                 .pending_proposer_slashings(cfg.max_proposer_slashings),
@@ -204,14 +221,18 @@ class ValidatorAPI:
         )
 
     def submit_attestation(self, att: Attestation) -> None:
-        """ProposeAttestation analog: pool + gossip."""
-        from ..p2p.bus import TOPIC_ATTESTATION
+        """ProposeAttestation analog: pool + per-subnet gossip
+        (beacon_attestation_{subnet}, reference §3.3)."""
+        from ..core.helpers import compute_subnet_for_attestation
+        from ..p2p.bus import attestation_subnet_topic
 
         if sum(att.aggregation_bits) == 1:
             self.node.att_pool.save_unaggregated(att)
         else:
             self.node.att_pool.save_aggregated(att)
-        self.node.peer.broadcast(TOPIC_ATTESTATION,
+        subnet = compute_subnet_for_attestation(
+            self.node.chain.head_state, att.data.slot, att.data.index)
+        self.node.peer.broadcast(attestation_subnet_topic(subnet),
                                  Attestation.serialize(att))
 
     def get_aggregate_attestation(self, slot: int,

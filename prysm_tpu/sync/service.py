@@ -44,16 +44,42 @@ class SyncService:
         self.seen_attestations: set[bytes] = set()
 
     def start(self) -> None:
+        from functools import partial
+
+        from ..config import beacon_config
+        from ..p2p.bus import attestation_subnet_topic
+
         self.peer.subscribe(TOPIC_BLOCK, self.on_block_gossip)
+        # one topic per attestation subnet (reference:
+        # beacon_attestation_{subnet}; this node subscribes to all —
+        # the --subscribe-all-subnets shape); the flat legacy topic
+        # stays for direct/fuzz injection
         self.peer.subscribe(TOPIC_ATTESTATION, self.on_attestation_gossip)
+        for subnet in range(beacon_config().attestation_subnet_count):
+            self.peer.subscribe(
+                attestation_subnet_topic(subnet),
+                partial(self._on_subnet_attestation, subnet))
         self.peer.subscribe(TOPIC_AGGREGATE, self.on_aggregate_gossip)
         self.peer.register_rpc(RPC_BLOCKS_BY_RANGE,
                                self.handle_blocks_by_range)
 
     def stop(self) -> None:
+        from ..config import beacon_config
+        from ..p2p.bus import attestation_subnet_topic
+
         self.peer.unsubscribe(TOPIC_BLOCK)
         self.peer.unsubscribe(TOPIC_ATTESTATION)
+        for subnet in range(beacon_config().attestation_subnet_count):
+            self.peer.unsubscribe(attestation_subnet_topic(subnet))
         self.peer.unsubscribe(TOPIC_AGGREGATE)
+
+    def _on_subnet_attestation(self, subnet: int, from_peer: str,
+                               data: bytes) -> Verdict:
+        """Subnet-topic wrapper: an attestation gossiped on the wrong
+        subnet is REJECTed (the reference's committee-index-to-subnet
+        check in validateCommitteeIndexBeaconAttestation)."""
+        return self.on_attestation_gossip(from_peer, data,
+                                          arrival_subnet=subnet)
 
     # --- gossip: blocks ----------------------------------------------------
 
@@ -127,7 +153,8 @@ class SyncService:
 
     # --- gossip: attestations ---------------------------------------------
 
-    def on_attestation_gossip(self, from_peer: str, data: bytes
+    def on_attestation_gossip(self, from_peer: str, data: bytes,
+                              arrival_subnet: int | None = None
                               ) -> Verdict:
         """validateCommitteeIndexBeaconAttestation analog.  Structural
         + committee checks here; the BLS check is DEFERRED to the
@@ -136,6 +163,22 @@ class SyncService:
             att = Attestation.deserialize(data)
         except Exception:
             return Verdict.REJECT
+        if arrival_subnet is not None:
+            from ..core.helpers import compute_subnet_for_attestation
+
+            try:
+                want = compute_subnet_for_attestation(
+                    self.chain.head_state, att.data.slot, att.data.index)
+            except Exception:
+                return Verdict.IGNORE
+            if want != arrival_subnet:
+                # spec p2p rule: wrong subnet -> REJECT.  The committee
+                # count driving the mapping is a function of the
+                # attestation's own epoch (active-set size), so honest
+                # senders and receivers agree except across an
+                # activation-boundary head race — accepted spec
+                # behavior, same as the reference's validator.
+                return Verdict.REJECT
         key = Attestation.hash_tree_root(att)
         with self._lock:
             if key in self.seen_attestations:

@@ -1,0 +1,122 @@
+"""Attestation subnet mapping + per-subnet gossip topics.
+
+Reference analog: helpers.ComputeSubnetForAttestation and the
+``beacon_attestation_{subnet}`` topic family validated by
+validateCommitteeIndexBeaconAttestation [U, SURVEY.md §2 "p2p",
+"sync svc"].
+"""
+
+import pytest
+
+from prysm_tpu.config import use_mainnet_config, use_minimal_config
+from prysm_tpu.core.helpers import compute_subnet_for_attestation
+from prysm_tpu.p2p import GossipBus
+from prysm_tpu.p2p.bus import Verdict, attestation_subnet_topic
+from prysm_tpu.proto import Attestation, build_types
+from prysm_tpu.testing import util as testutil
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_config():
+    use_minimal_config()
+    yield
+    use_mainnet_config()
+
+
+@pytest.fixture(scope="module")
+def types():
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    return build_types(MINIMAL_CONFIG)
+
+
+@pytest.fixture(scope="module")
+def genesis(types):
+    return testutil.deterministic_genesis_state(16, types)
+
+
+class TestSubnetMapping:
+    def test_in_range_and_deterministic(self, genesis):
+        from prysm_tpu.config import beacon_config
+
+        cfg = beacon_config()
+        subnets = {
+            (slot, idx): compute_subnet_for_attestation(genesis, slot, idx)
+            for slot in range(cfg.slots_per_epoch) for idx in range(2)}
+        assert all(0 <= s < cfg.attestation_subnet_count
+                   for s in subnets.values())
+        # same inputs -> same subnet
+        assert subnets[(1, 0)] == compute_subnet_for_attestation(
+            genesis, 1, 0)
+
+    def test_distinct_committees_distinct_subnets(self, genesis):
+        """Within an epoch (fewer total committees than subnets) the
+        mapping is injective."""
+        from prysm_tpu.config import beacon_config
+        from prysm_tpu.core.helpers import get_committee_count_per_slot
+
+        cfg = beacon_config()
+        count = get_committee_count_per_slot(genesis, 0)
+        seen = set()
+        for slot in range(cfg.slots_per_epoch):
+            for idx in range(count):
+                seen.add(compute_subnet_for_attestation(genesis, slot, idx))
+        assert len(seen) == cfg.slots_per_epoch * count
+
+
+def _make_node(bus, peer_id, genesis, types):
+    from prysm_tpu.blockchain import BlockchainService
+    from prysm_tpu.db import setup_db
+    from prysm_tpu.operations import AttestationPool
+    from prysm_tpu.stategen import StateGen
+    from prysm_tpu.sync import SyncService
+
+    db = setup_db(types=types)
+    gen = StateGen(db, types=types)
+    root = testutil._header_root_with_state(genesis)
+    chain = BlockchainService(db, gen, genesis.copy(), root, types=types)
+    pool = AttestationPool()
+    peer = bus.join(peer_id)
+    sync = SyncService(peer, chain, pool, types=types)
+    sync.start()
+    return chain, sync, peer, pool
+
+
+class TestSubnetGossip:
+    def _two_nodes(self, genesis, types):
+        bus = GossipBus()
+        a = _make_node(bus, "a", genesis, types)
+        b = _make_node(bus, "b", genesis, types)
+        return bus, a, b
+
+    def test_correct_subnet_accepted(self, genesis, types):
+        bus, (chain_a, sync_a, peer_a, _), (chain_b, *_rest) = (
+            self._two_nodes(genesis, types))
+        pool_b = _rest[-1]
+        blk = testutil.generate_full_block(genesis.copy(), slot=1)
+        chain_a.receive_block(blk)
+        chain_b.receive_block(blk)
+
+        att = testutil.valid_attestation(chain_b.head_state, 1, 0)
+        subnet = compute_subnet_for_attestation(chain_b.head_state, 1, 0)
+        verdicts = peer_a.broadcast(attestation_subnet_topic(subnet),
+                                    Attestation.serialize(att))
+        assert verdicts["b"] == Verdict.ACCEPT
+        assert (pool_b.unaggregated_count()
+                + pool_b.aggregated_count()) >= 1
+
+    def test_wrong_subnet_rejected(self, genesis, types):
+        bus, (chain_a, sync_a, peer_a, _), (chain_b, *_rest) = (
+            self._two_nodes(genesis, types))
+        blk = testutil.generate_full_block(genesis.copy(), slot=1)
+        chain_a.receive_block(blk)
+        chain_b.receive_block(blk)
+
+        att = testutil.valid_attestation(chain_b.head_state, 1, 0)
+        subnet = compute_subnet_for_attestation(chain_b.head_state, 1, 0)
+        from prysm_tpu.config import beacon_config
+
+        wrong = (subnet + 1) % beacon_config().attestation_subnet_count
+        verdicts = peer_a.broadcast(attestation_subnet_topic(wrong),
+                                    Attestation.serialize(att))
+        assert verdicts["b"] == Verdict.REJECT
