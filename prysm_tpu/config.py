@@ -48,6 +48,8 @@ class BeaconChainConfig:
     min_seed_lookahead: int = 1
     max_seed_lookahead: int = 4
     epochs_per_eth1_voting_period: int = 64
+    seconds_per_eth1_block: int = 14
+    eth1_follow_distance: int = 2048
     slots_per_historical_root: int = 8192
     min_validator_withdrawability_delay: int = 256
     shard_committee_period: int = 256
@@ -108,6 +110,7 @@ MINIMAL_CONFIG = dataclasses.replace(
     seconds_per_slot=6,
     slots_per_epoch=8,
     epochs_per_eth1_voting_period=4,
+    eth1_follow_distance=16,
     slots_per_historical_root=64,
     min_validator_withdrawability_delay=256,
     shard_committee_period=64,

@@ -44,8 +44,11 @@ class BeaconNode:
 
     def __init__(self, bus: GossipBus, node_id: str, genesis_state,
                  db_path: str = ":memory:", types=None,
-                 time_fn=time.time):
+                 time_fn=time.time, powchain=None):
         self.node_id = node_id
+        # optional eth1 follower (powchain.PowchainService) — block
+        # production falls back to carrying eth1_data forward without it
+        self.powchain = powchain
         self.types = types or active_types()
         self.metrics = MetricsRegistry()
         self.events = EventFeed()

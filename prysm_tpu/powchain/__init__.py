@@ -1,0 +1,6 @@
+"""Eth1 follower: deposit cache + eth1-data voting (reference
+beacon-chain/powchain [U, SURVEY.md §2])."""
+
+from .service import Eth1Block, MockEth1Chain, PowchainService
+
+__all__ = ["Eth1Block", "MockEth1Chain", "PowchainService"]

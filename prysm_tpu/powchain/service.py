@@ -1,0 +1,176 @@
+"""Eth1 chain follower: deposit cache + eth1-data voting.
+
+Reference analog: ``beacon-chain/powchain`` (eth1 log processing,
+deposit trie cache, ``ChainStartFetcher``/``ETH1DataFetcher``) [U,
+SURVEY.md §2 "Deposit contract", §3.1].  Real networking stays
+host-side per SURVEY §5; the eth1 endpoint is modeled by
+``MockEth1Chain`` the way the reference's tests model it with a
+simulated backend — the service logic (follow distance, voting-period
+candidate selection, deposit proofs for inclusion) is the real
+algorithm.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from ..config import beacon_config
+from ..core.deposits import DepositTree
+from ..proto import Deposit, DepositData, Eth1Data
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    timestamp: int
+    deposit_count: int
+    deposit_root: bytes
+    hash: bytes = b""
+
+    def __post_init__(self):
+        if not self.hash:
+            self.hash = hashlib.sha256(
+                b"eth1-%d-%d" % (self.number, self.timestamp)).digest()
+
+
+class MockEth1Chain:
+    """In-process stand-in for the eth1 RPC endpoint (the reference
+    tests' simulated backend): a linear chain of blocks plus the
+    deposit contract log."""
+
+    def __init__(self, genesis_time: int = 0,
+                 block_interval: int | None = None):
+        cfg = beacon_config()
+        self.block_interval = block_interval or cfg.seconds_per_eth1_block
+        self.genesis_time = genesis_time
+        self.tree = DepositTree()
+        self.deposit_datas: list[DepositData] = []
+        self.blocks: list[Eth1Block] = [
+            Eth1Block(number=0, timestamp=genesis_time, deposit_count=0,
+                      deposit_root=self.tree.root())]
+
+    @property
+    def head(self) -> Eth1Block:
+        return self.blocks[-1]
+
+    def add_block(self, deposits: list[DepositData] | None = None,
+                  timestamp: int | None = None) -> Eth1Block:
+        for d in deposits or []:
+            self.deposit_datas.append(d)
+            self.tree.push(DepositData.hash_tree_root(d))
+        blk = Eth1Block(
+            number=self.head.number + 1,
+            timestamp=(timestamp if timestamp is not None
+                       else self.head.timestamp + self.block_interval),
+            deposit_count=self.tree.count,
+            deposit_root=self.tree.root())
+        self.blocks.append(blk)
+        return blk
+
+    def block_by_number(self, number: int) -> Eth1Block | None:
+        if 0 <= number < len(self.blocks):
+            return self.blocks[number]
+        return None
+
+    def block_by_timestamp(self, ts: int) -> Eth1Block:
+        """Latest block with timestamp <= ts (the voting-period range
+        computation's primitive)."""
+        best = self.blocks[0]
+        for b in self.blocks:
+            if b.timestamp <= ts:
+                best = b
+            else:
+                break
+        return best
+
+
+class PowchainService:
+    """Deposit cache + eth1 data provider for block production."""
+
+    def __init__(self, eth1: MockEth1Chain):
+        self.eth1 = eth1
+        # proofs are against the partial tree of exactly `count`
+        # leaves; cache the snapshot per count so block production
+        # doesn't rehash the whole contract log every slot
+        self._snapshot_count: int = -1
+        self._snapshot: DepositTree | None = None
+
+    # --- eth1 data voting ---------------------------------------------------
+
+    def _voting_period_start_time(self, state) -> int:
+        cfg = beacon_config()
+        period_slots = cfg.slots_per_eth1_voting_period()
+        start_slot = state.slot - state.slot % period_slots
+        return state.genesis_time + start_slot * cfg.seconds_per_slot
+
+    def get_eth1_vote(self, state) -> Eth1Data:
+        """The spec's get_eth1_vote: candidates are follow-distance
+        aged blocks in the current voting period; vote with the
+        existing majority among candidates, else the newest candidate,
+        else keep the state's eth1_data."""
+        cfg = beacon_config()
+        period_start = self._voting_period_start_time(state)
+        lag = cfg.eth1_follow_distance * cfg.seconds_per_eth1_block
+        newest = self.eth1.block_by_timestamp(period_start - lag)
+        oldest = self.eth1.block_by_timestamp(period_start - 2 * lag)
+        candidates = [
+            self.eth1.block_by_number(n)
+            for n in range(oldest.number, newest.number + 1)]
+        # spec is_candidate_block: the block must be aged by at least
+        # the follow distance but no more than twice it (the timestamp
+        # walk above can hand back out-of-window blocks at the chain
+        # edges); deposit count must also never roll back
+        valid = [
+            b for b in candidates
+            if b.timestamp + lag <= period_start
+            and b.timestamp + 2 * lag >= period_start
+            and b.deposit_count >= state.eth1_data.deposit_count]
+        if not valid:
+            return state.eth1_data.copy()
+
+        def to_data(b: Eth1Block) -> Eth1Data:
+            return Eth1Data(deposit_root=b.deposit_root,
+                            deposit_count=b.deposit_count,
+                            block_hash=b.hash)
+
+        valid_datas = [to_data(b) for b in valid]
+        votes = [v for v in state.eth1_data_votes if v in valid_datas]
+        if votes:
+            # majority vote, ties broken by order of appearance
+            best, best_n = None, 0
+            for v in valid_datas:
+                n = votes.count(v)
+                if n > best_n:
+                    best, best_n = v, n
+            if best is not None:
+                return best
+        return valid_datas[-1]
+
+    # --- deposits for inclusion --------------------------------------------
+
+    def deposits_for_inclusion(self, state,
+                               eth1_data: Eth1Data | None = None
+                               ) -> list[Deposit]:
+        """Up to MAX_DEPOSITS deposits from eth1_deposit_index toward
+        eth1_data.deposit_count (default: the state's), with proofs
+        against the PARTIAL tree of exactly deposit_count leaves (what
+        process_deposit verifies).  Callers producing a block pass the
+        eth1_data that will be IN EFFECT after the block's vote is
+        processed."""
+        cfg = beacon_config()
+        eth1_data = eth1_data or state.eth1_data
+        target = min(eth1_data.deposit_count,
+                     len(self.eth1.deposit_datas))
+        start = state.eth1_deposit_index
+        if start >= target:
+            return []
+        n = min(cfg.max_deposits, target - start)
+        if self._snapshot_count != target or self._snapshot is None:
+            snapshot = DepositTree()
+            for d in self.eth1.deposit_datas[:target]:
+                snapshot.push(DepositData.hash_tree_root(d))
+            self._snapshot, self._snapshot_count = snapshot, target
+        return [Deposit(proof=self._snapshot.proof(i),
+                        data=self.eth1.deposit_datas[i])
+                for i in range(start, start + n)]
