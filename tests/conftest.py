@@ -38,6 +38,19 @@ jax.config.update("jax_platforms", "cpu")
 # config key must be set explicitly or nothing is ever cached
 jax.config.update("jax_compilation_cache_dir",
                   os.environ["JAX_COMPILATION_CACHE_DIR"])
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# Cache WRITES are disabled for full-suite runs: jaxlib's native
+# executable.serialize() segfaults non-deterministically in
+# long-running processes that have done many prior CPU compiles
+# (observed twice, deterministically, at the 16th test of a full run —
+# jax/_src/compilation_cache.py put_executable_and_time; the same
+# entry writes fine from a fresh process).  Reads are unaffected, so
+# the suite still loads a warm cache.  To (re)populate the cache, run
+# individual test files with PRYSM_CACHE_WRITE=1:
+#   for f in tests/test_*.py; do PRYSM_CACHE_WRITE=1 pytest "$f"; done
+if os.environ.get("PRYSM_CACHE_WRITE") == "1":
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+else:
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      1e18)
 assert jax.devices()[0].platform == "cpu"
 assert len(jax.devices()) == 8, jax.devices()
