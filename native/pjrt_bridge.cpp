@@ -19,7 +19,9 @@
 //   pb_device_count(ctx)
 //   pb_platform_name(ctx, out, outlen)
 //   pb_compile(ctx, code, code_len, format, copts, copts_len, &exec, ...)
-//   pb_execute(ctx, exec, inputs[], n_inputs, output, ...)
+//   pb_execute(ctx, exec, input_data[], input_dims[], input_ndims[],
+//              input_dtypes[], n_inputs, out, out_bytes,
+//              out_dims, out_ndims, out_elem_size, err, errlen)
 //   pb_exec_destroy(ctx, exec); pb_destroy(ctx)
 //
 // `options_spec` is newline-separated "name\ttype\tvalue" with type
@@ -343,7 +345,8 @@ int pb_compile(void* ctx_v, const char* code, size_t code_len,
 int pb_execute(void* ctx_v, void* exec_v, const void** input_data,
                const int64_t* const* input_dims, const size_t* input_ndims,
                const int* input_dtypes, size_t n_inputs, void* out,
-               size_t out_bytes, char* err, size_t errlen) {
+               size_t out_bytes, const int64_t* out_dims, size_t out_ndims,
+               size_t out_elem_size, char* err, size_t errlen) {
   auto* ctx = static_cast<PbContext*>(ctx_v);
   auto* exec = static_cast<PJRT_LoadedExecutable*>(exec_v);
   const PJRT_Api* api = ctx->api;
@@ -446,11 +449,40 @@ int pb_execute(void* ctx_v, void* exec_v, const void** input_data,
   }
 
   dbg("execution event done");
-  // Device -> host.
+  // Device -> host.  Request a dense row-major host layout explicitly:
+  // with host_layout null the copy dumps the DEVICE layout, which on
+  // TPU is minor-to-major reversed (observed: transposed readback).
+  // The plugin only accepts Tiled (dense minor_to_major) layout specs,
+  // matching jaxlib's ToLiteral path.
+  uint64_t want_bytes = out_elem_size;
+  for (size_t i = 0; i < out_ndims; ++i) {
+    want_bytes *= static_cast<uint64_t>(out_dims[i]);
+  }
+  if (want_bytes != out_bytes) {
+    cleanup();
+    set_err(err, errlen,
+            "out_bytes " + std::to_string(out_bytes) +
+                " does not match dims*elem_size " +
+                std::to_string(want_bytes));
+    return -1;
+  }
+  std::vector<int64_t> minor_to_major(out_ndims);
+  for (size_t i = 0; i < out_ndims; ++i) {
+    minor_to_major[i] = static_cast<int64_t>(out_ndims - 1 - i);
+  }
+  PJRT_Buffer_MemoryLayout layout;
+  memset(&layout, 0, sizeof(layout));
+  layout.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
+  layout.type = PJRT_Buffer_MemoryLayout_Type_Tiled;
+  layout.tiled.struct_size = PJRT_Buffer_MemoryLayout_Tiled_STRUCT_SIZE;
+  layout.tiled.minor_to_major = minor_to_major.data();
+  layout.tiled.minor_to_major_size = minor_to_major.size();
+
   PJRT_Buffer_ToHostBuffer_Args hargs;
   memset(&hargs, 0, sizeof(hargs));
   hargs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
   hargs.src = out_buf;
+  hargs.host_layout = out_ndims ? &layout : nullptr;
   hargs.dst = out;
   hargs.dst_size = out_bytes;
   msg = check(api, api->PJRT_Buffer_ToHostBuffer(&hargs));

@@ -34,8 +34,15 @@ _ERRLEN = 4096
 
 
 def ensure_built() -> Path:
-    """Build the bridge library if missing (mirrors hashbridge)."""
-    if not BRIDGE_LIB.exists():
+    """Build the bridge library if missing or stale (the pb_execute
+    ABI has changed before; loading an older .so against the current
+    ctypes signatures silently misbinds arguments)."""
+    src = _NATIVE_DIR / "pjrt_bridge.cpp"
+    hdr = _NATIVE_DIR / "third_party" / "pjrt_c_api.h"
+    stale = (not BRIDGE_LIB.exists()
+             or BRIDGE_LIB.stat().st_mtime < max(
+                 src.stat().st_mtime, hdr.stat().st_mtime))
+    if stale:
         subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
                        capture_output=True)
     return BRIDGE_LIB
@@ -69,6 +76,8 @@ def load_bridge() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int),              # input_dtypes
         ctypes.c_size_t,                           # n_inputs
         ctypes.c_void_p, ctypes.c_size_t,          # out, out_bytes
+        ctypes.POINTER(ctypes.c_int64),            # out_dims
+        ctypes.c_size_t, ctypes.c_size_t,          # out_ndims, elem size
         ctypes.c_char_p, ctypes.c_size_t]
     lib.pb_exec_destroy.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.pb_destroy.argtypes = [ctypes.c_void_p]
@@ -175,8 +184,8 @@ class PjrtBridgeClient:
             raise RuntimeError(f"pb_compile: {err.value.decode()}")
         return exec_h
 
-    def execute(self, exec_h, inputs: list[np.ndarray],
-                out_bytes: int) -> bytes:
+    def execute(self, exec_h, inputs: list[np.ndarray], out_bytes: int,
+                out_shape: tuple = (), out_elem_size: int = 4) -> bytes:
         n = len(inputs)
         data = (ctypes.c_void_p * n)()
         dims = (ctypes.POINTER(ctypes.c_int64) * n)()
@@ -196,10 +205,13 @@ class PjrtBridgeClient:
             dims[i] = d
             ndims[i] = arr.ndim
         out = ctypes.create_string_buffer(out_bytes)
+        odims = (ctypes.c_int64 * max(len(out_shape), 1))(
+            *(out_shape or (0,)))
         err = ctypes.create_string_buffer(_ERRLEN)
         rc = self.lib.pb_execute(
             self.ctx, exec_h, data, dims, ndims, dtypes, n,
-            out, out_bytes, err, _ERRLEN)
+            out, out_bytes, odims, len(out_shape), out_elem_size,
+            err, _ERRLEN)
         if rc != 0:
             raise RuntimeError(f"pb_execute: {err.value.decode()}")
         return out.raw
@@ -328,10 +340,16 @@ def demo_verify_batch(n_committees: int = 4, committee_size: int = 4) -> dict:
     info["compile_s"] = round(time.perf_counter() - t0, 3)
     print("bridge-demo: compiled", file=sys.stderr, flush=True)
     # warmup + timed run
-    out = client.execute(exec_h, prog["inputs"], prog["out_bytes"])
+    def run():
+        return client.execute(
+            exec_h, prog["inputs"], prog["out_bytes"],
+            out_shape=prog["out_shape"],
+            out_elem_size=prog["out_dtype"].itemsize)
+
+    out = run()
     print("bridge-demo: first execute done", file=sys.stderr, flush=True)
     t0 = time.perf_counter()
-    out = client.execute(exec_h, prog["inputs"], prog["out_bytes"])
+    out = run()
     info["execute_s"] = round(time.perf_counter() - t0, 4)
     if "expected" in prog:
         got = np.frombuffer(out, dtype=np.uint32).reshape(prog["out_shape"])
