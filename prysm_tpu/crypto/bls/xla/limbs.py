@@ -79,36 +79,75 @@ ZERO = np.zeros(NLIMBS, dtype=np.uint32)
 # --- carry / compare helpers ----------------------------------------------
 
 
+def _shift_up(x, k: int = 1, fill: int = 0):
+    """Shift limbs toward the more-significant end by k positions
+    (``fill`` at the bottom): out[i] = x[i-k]."""
+    pads = [(0, 0)] * (x.ndim - 1) + [(k, 0)]
+    return jnp.pad(x[..., :-k], pads, constant_values=fill)
+
+
+def _carry_resolve(x, n: int):
+    """Exact carry propagation over limbs in LOG depth.
+
+    ``x`` holds per-limb values <= 2**16 (i.e. at most a single
+    pending carry each, established by the fold passes in callers).
+    Returns (low 16-bit limbs with carries applied, carry out of the
+    top limb).  Uses the Kogge-Stone generate/propagate prefix:
+    carry-out of limb i is g_i OR (p_i AND carry-in), with
+    g = value >> 16 and p = (value == 0xffff); the combine
+    (g2,p2)∘(g1,p1) = (g2 | p2&g1, p2&p1) is associative, so the
+    prefix resolves in ceil(log2 n) steps instead of an n-step scan —
+    the n-step lax.scan ripple was the dominant serialization of every
+    field multiply on TPU."""
+    g = x >> RADIX_BITS                      # 0/1
+    p = (x & MASK32) == MASK32
+    p = p.astype(jnp.uint32)
+    shift = 1
+    while shift < n:
+        # identity element is (g=0, p=1)
+        gs = _shift_up(g, shift)
+        ps = _shift_up(p, shift, fill=1)
+        g = g | (p & gs)
+        p = p & ps
+        shift *= 2
+    carry_in = _shift_up(g)                  # c[i] = G[i-1], c[0] = 0
+    out = (x + carry_in) & MASK32
+    return out, g[..., -1]
+
+
 def _carry_norm(cols, n_out: int):
-    """Ripple-carry a redundant column vector (entries < 2**26) into
-    canonical 16-bit limbs via lax.scan over the limb axis.  Returns
-    uint32[..., n_out]; the carry out of the top requested limb is
-    dropped — i.e. the result is reduced mod 2**(16*n_out).  Callers
-    either guarantee the carry is zero (values known < 2**384) or rely
-    on the wrap (fp_sub's +P correction, _mont_reduce's t_lo mod R)."""
-    xs = jnp.moveaxis(cols[..., :n_out], -1, 0)
+    """Normalize a redundant column vector (entries < 2**26) into
+    canonical 16-bit limbs.  Returns uint32[..., n_out]; the carry out
+    of the top requested limb is dropped — i.e. the result is reduced
+    mod 2**(16*n_out).  Callers either guarantee the carry is zero
+    (values known < 2**384) or rely on the wrap (fp_sub's +P
+    correction, _mont_reduce's t_lo mod R).
 
-    def body(carry, col):
-        v = col + carry
-        return v >> RADIX_BITS, v & MASK32
-
-    # derive the init from the operand so its sharding/varying axes
-    # match under shard_map (a fresh constant would not)
-    _, outs = lax.scan(body, cols[..., 0] & jnp.uint32(0), xs)
-    return jnp.moveaxis(outs, 0, -1)
+    Two fold passes squeeze every limb to <= 2**16 (one pending carry
+    at most), then _carry_resolve finishes in log depth."""
+    x = cols[..., :n_out]
+    for _ in range(2):
+        x = (x & MASK32) + _shift_up(x >> RADIX_BITS)
+    out, _ = _carry_resolve(x, n_out)
+    return out
 
 
 def _sub_borrow(a, b_limbs):
-    """a - b over 24 limbs; returns (diff mod 2**384, borrow in {0,1})."""
-    xs = jnp.moveaxis(jnp.stack(
-        [a, jnp.broadcast_to(b_limbs, a.shape)], axis=0), -1, 0)
+    """a - b over 24 limbs; returns (diff mod 2**384, borrow in {0,1}).
 
-    def body(borrow, ab):
-        d = ab[0] + np.uint32(RADIX) - ab[1] - borrow
-        return jnp.uint32(1) - (d >> RADIX_BITS), d & MASK32
-
-    borrow, outs = lax.scan(body, a[..., 0] & jnp.uint32(0), xs)
-    return jnp.moveaxis(outs, 0, -1), borrow
+    Two's-complement formulation so the log-depth carry resolver does
+    the work: a - b = a + ~b + 1 with borrow = NOT carry-out."""
+    b = jnp.broadcast_to(b_limbs, a.shape)
+    s = a + (MASK32 - b)                     # entries <= 2**17 - 2
+    one = jnp.zeros_like(s).at[..., 0].set(jnp.uint32(1))
+    s = s + one
+    hi = s >> RADIX_BITS
+    # the fold's _shift_up DROPS the top limb's own carry — it is part
+    # of the 385th bit and must count toward the final carry-out
+    top_carry = hi[..., -1]
+    s = (s & MASK32) + _shift_up(hi)         # fold: <= 2**16
+    diff, carry_out = _carry_resolve(s, a.shape[-1])
+    return diff, jnp.uint32(1) - (top_carry | carry_out)
 
 
 def _add_limbs_mod_2_384(a, b_limbs):

@@ -102,3 +102,65 @@ class TestFieldOps:
         packed = L.pack_ints(xs, mont=False)
         assert list(np.asarray(L.fp_is_zero(packed))) == [True, False, True,
                                                           False]
+
+
+class TestCarryChains:
+    """Directed adversarial carry/borrow chains for the log-depth
+    (fold + Kogge-Stone) normalization: random vectors essentially
+    never produce long runs of 0xffff limbs, which is exactly the case
+    where a propagate-identity regression would hide."""
+
+    def test_full_propagate_chain_add(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from prysm_tpu.crypto.bls.xla import limbs as L
+
+        # (2**368 - 1) + 1: carry must ripple across 23 limbs of 0xffff
+        a = jnp.asarray(L.int_to_limbs_np((1 << 368) - 1))[None]
+        b = jnp.asarray(L.int_to_limbs_np(1))[None]
+        out = L._add_limbs_mod_2_384(a, b)
+        assert L.limbs_to_int(np.asarray(out)[0]) == (1 << 368)
+
+    def test_full_borrow_chain_sub(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from prysm_tpu.crypto.bls.xla import limbs as L
+
+        cases = [((1 << 384) - 1, 0, 0),      # max - 0: no borrow
+                 (0, 1, 1),                   # 0 - 1: full borrow chain
+                 (1 << 383, 1, 0),            # borrow across 23 limbs
+                 (12345, 12345, 0)]           # equal: zero, no borrow
+        for x, y, want_borrow in cases:
+            a = jnp.asarray(L.int_to_limbs_np(x))[None]
+            b = jnp.asarray(L.int_to_limbs_np(y))[None]
+            d, borrow = L._sub_borrow(a, b)
+            assert int(np.asarray(borrow)[0]) == want_borrow, (x, y)
+            assert (L.limbs_to_int(np.asarray(d)[0])
+                    == (x - y) % (1 << 384)), (x, y)
+
+    def test_csub_p_boundaries(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from prysm_tpu.crypto.bls.params import P
+        from prysm_tpu.crypto.bls.xla import limbs as L
+
+        for v in (0, 1, P - 1, P, P + 1, 2 * P - 1):
+            arr = jnp.asarray(L.int_to_limbs_np(v))[None]
+            out = L.limbs_to_int(np.asarray(L._csub_p(arr))[0])
+            assert out == (v - P if v >= P else v), v
+
+    def test_mont_mul_all_ffff_operands(self):
+        import numpy as np
+
+        from prysm_tpu.crypto.bls.params import P
+        from prysm_tpu.crypto.bls.xla import limbs as L
+
+        vals = [int("ffff" * 24, 16) % P, P - 1,
+                int("ffff0000" * 12, 16) % P]
+        a = L.pack_ints(vals)
+        out = L.unpack_ints(L.fp_mul(a, a))
+        for v, o in zip(vals, out):
+            assert o == (v * v) % P
