@@ -1,0 +1,35 @@
+# Developer entry points.  The compile-cache story (VERDICT r2 #6):
+# the CPU suite reads .jax_cache_cpu/<host-fingerprint>/ but does not
+# write it (long-running multi-compile processes can segfault in
+# jaxlib's executable.serialize); `make warm-cache` populates it with
+# one short-lived process per test file, plus the driver's multichip
+# dryrun graphs.
+
+PY ?= python
+
+.PHONY: test test-slow warm-cache dryrun bench native
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+test-slow:
+	$(PY) -m pytest tests/ -q -m slow
+
+# Populate the fingerprint-keyed CPU compile cache on THIS host.
+# Per-file processes keep each run's compile count low enough that
+# cache serialization stays reliable; the dryrun warms the driver's
+# multichip graphs (same shapes as tests/test_multichip.py).
+warm-cache:
+	set -e; for f in tests/test_*.py; do \
+		PRYSM_CACHE_WRITE=1 $(PY) -m pytest "$$f" -x -q || exit 1; \
+	done
+	$(PY) __graft_entry__.py --multichip 8
+
+dryrun:
+	$(PY) __graft_entry__.py --multichip 8
+
+bench:
+	$(PY) bench.py
+
+native:
+	$(MAKE) -C native

@@ -169,17 +169,19 @@ def bench_htr_registry():
 
 
 def bench_epoch_replay():
-    """BASELINE config #5: one epoch of full blocks replayed through
-    the state transition with whole-batch signature verification on
-    the xla backend (initial-sync throughput shape)."""
+    """BASELINE config #5 at spec shape: a 32-block MAINNET-fork epoch
+    replayed through the state transition with whole-batch signature
+    verification on the xla backend (initial-sync throughput shape).
+    Validator count is 256 (pure-python block generation at 500k is
+    infeasible on this host; the per-block transition cost model is
+    what the metric tracks — stated in the unit for honesty)."""
     import time as _t
 
-    from prysm_tpu.config import (
-        MINIMAL_CONFIG, set_features, use_minimal_config,
-    )
+    from prysm_tpu.config import set_features, use_mainnet_config
 
-    use_minimal_config()
+    use_mainnet_config()
     set_features(bls_implementation="xla")
+    from prysm_tpu.config import MAINNET_CONFIG
     from prysm_tpu.proto import build_types
     from prysm_tpu.testing.util import (
         deterministic_genesis_state, generate_full_block,
@@ -188,11 +190,11 @@ def bench_epoch_replay():
         collect_block_signature_batch, process_slots, state_transition,
     )
 
-    types = build_types(MINIMAL_CONFIG)
-    genesis = deterministic_genesis_state(64, types)
+    types = build_types(MAINNET_CONFIG)
+    genesis = deterministic_genesis_state(256, types)
     st = genesis.copy()
     blocks = []
-    for slot in range(1, 9):          # one minimal epoch
+    for slot in range(1, 33):         # one mainnet epoch: 32 blocks
         blk = generate_full_block(st, slot=slot)
         state_transition(st, blk, types, verify_signatures=False)
         blocks.append(blk)
@@ -217,10 +219,55 @@ def bench_epoch_replay():
     return {
         "metric": "epoch_replay_blocks_per_sec",
         "value": round(bps, 2),
-        "unit": "blocks/sec (8-slot minimal epoch, 64 validators, "
+        "unit": "blocks/sec (32-block mainnet epoch, 256 validators, "
                 "batched sig verify)",
         # CPU initial-sync replay order-of-magnitude ~20 blocks/s [U]
         "vs_baseline": round(bps / 20.0, 4),
+    }
+
+
+def bench_htr_state_warm():
+    """BASELINE config #4 companion: WARM incremental BeaconState root
+    at 500k validators through the dirty-field cache (one balance +
+    one validator dirtied per root, the per-slot recompute shape).
+    The [U] baseline for warm incremental is ms-scale on CPU."""
+    import hashlib as _hl
+    import time as _t
+
+    from prysm_tpu.config import use_mainnet_config
+
+    use_mainnet_config()
+    from prysm_tpu.config import MAINNET_CONFIG
+    from prysm_tpu.proto import FAR_FUTURE_EPOCH, Validator, build_types
+
+    types = build_types(MAINNET_CONFIG)
+    n = 500_000
+    validators = [
+        Validator(pubkey=i.to_bytes(48, "little"),
+                  withdrawal_credentials=_hl.sha256(
+                      i.to_bytes(8, "little")).digest(),
+                  effective_balance=32_000_000_000, slashed=False,
+                  activation_eligibility_epoch=0, activation_epoch=0,
+                  exit_epoch=FAR_FUTURE_EPOCH,
+                  withdrawable_epoch=FAR_FUTURE_EPOCH)
+        for i in range(n)]
+    state = types.BeaconState(
+        validators=validators, balances=[32_000_000_000] * n)
+    types.BeaconState.hash_tree_root(state)     # cold build
+    times = []
+    for i in range(3):
+        state.balances[i * 7 + 1] += 1
+        state.validators[i * 11 + 3].effective_balance -= 1
+        t0 = _t.perf_counter()
+        types.BeaconState.hash_tree_root(state)
+        times.append(_t.perf_counter() - t0)
+    t = sorted(times)[len(times) // 2]
+    return {
+        "metric": "beacon_state_htr_warm_500k",
+        "value": round(t * 1e3, 3),
+        "unit": "ms/root (500k validators, dirty-field cache)",
+        # CPU warm incremental ms-scale [BASELINE.md]; use 10 ms
+        "vs_baseline": round(10e-3 / t, 4),
     }
 
 
@@ -252,12 +299,19 @@ TIERS = [
     # (name, fn, wall budget seconds — generous for first compiles;
     # the persistent cache makes reruns fast)
     ("slot_verify", bench_slot_verify, 2400),
-    ("epoch_replay", bench_epoch_replay, 1200),
+    ("epoch_replay", bench_epoch_replay, 1800),
     ("aggregate_verify", bench_aggregate_verify, 900),
     ("single_verify", bench_single_verify, 700),
     ("htr_registry", bench_htr_registry, 500),
+    ("htr_state_warm", bench_htr_state_warm, 900),
     ("field_throughput", bench_field_throughput, 300),
 ]
+
+# the five BASELINE.json configs (plus companions) recorded every
+# round into BENCH_FULL.json — VERDICT r2 #4: per-tier regressions
+# must be visible, not just the metric of record
+FULL_TIERS = ("single_verify", "aggregate_verify", "slot_verify",
+              "htr_registry", "htr_state_warm", "epoch_replay")
 
 
 def _run_tier_subprocess(name: str, budget: int) -> str | None:
@@ -289,16 +343,43 @@ def main() -> None:
         fn = dict((n, f) for n, f, _b in TIERS)[sys.argv[2]]
         print(json.dumps(fn()))
         return
+    # 1) the driver contract: print the metric-of-record line FIRST
+    # (falling through tiers until one succeeds), so a driver-side
+    # timeout during the full sweep below cannot lose it
+    budgets = dict((n, b) for n, _f, b in TIERS)
+    results: dict[str, dict] = {}
     attempted = []
+    printed = False
     for name, fn, budget in TIERS:
         attempted.append(name)
         line = _run_tier_subprocess(name, budget)
         if line is not None:
-            print(line)
-            return
-    print(json.dumps({"metric": "error", "value": 0,
-                      "unit": f"all tiers failed: {attempted}",
-                      "vs_baseline": 0}))
+            results[name] = json.loads(line)
+            print(line, flush=True)
+            printed = True
+            break
+    if not printed:
+        print(json.dumps({"metric": "error", "value": 0,
+                          "unit": f"all tiers failed: {attempted}",
+                          "vs_baseline": 0}), flush=True)
+        return
+    # 2) the full sweep (VERDICT r2 #4): every BASELINE config,
+    # recorded to BENCH_FULL.json; skip with PRYSM_BENCH_FULL=0
+    if os.environ.get("PRYSM_BENCH_FULL", "1") == "0":
+        return
+    for name in FULL_TIERS:
+        if name in results:
+            continue
+        line = _run_tier_subprocess(name, budgets[name])
+        results[name] = (json.loads(line) if line is not None
+                         else {"metric": name, "value": 0,
+                               "unit": "FAILED/timeout",
+                               "vs_baseline": 0})
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_FULL.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"# full sweep written to {out}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -296,11 +296,24 @@ def compute_proposer_index(state, indices: list[int], seed: bytes,
 
 
 def get_beacon_proposer_index(state, cfg=None) -> int:
+    return get_beacon_proposer_index_at_slot(state, state.slot, cfg)
+
+
+def get_beacon_proposer_index_at_slot(state, slot: int,
+                                      cfg=None) -> int:
+    """Proposer for any slot of the state's CURRENT epoch without
+    advancing the state: the epoch seed, active set, and effective
+    balances are all epoch-constant, so only the slot mixed into the
+    seed varies.  Lets duties endpoints resolve a whole epoch of
+    proposers from one state (no per-slot state advancement)."""
     cfg = cfg or beacon_config()
     epoch = get_current_epoch(state)
+    if slot // cfg.slots_per_epoch != epoch:
+        raise ValueError(
+            f"slot {slot} outside the state's current epoch {epoch}")
     seed = _sha256(
         get_seed(state, epoch, cfg.domain_beacon_proposer, cfg)
-        + state.slot.to_bytes(8, "little"))
+        + slot.to_bytes(8, "little"))
     indices = get_active_validator_indices(state, epoch)
     return compute_proposer_index(state, indices, seed, cfg)
 

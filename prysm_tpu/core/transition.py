@@ -245,6 +245,27 @@ def is_valid_merkle_branch(leaf: bytes, branch, depth: int, index: int,
     return value == root
 
 
+def pubkey_index_map(state) -> dict:
+    """pubkey -> validator index, cached on the state instance and
+    extended incrementally (the per-deposit dict rebuild was O(n)
+    per deposit, O(n*d) per deposit-heavy block).  The cache carries
+    the backing list's identity+length so a wholesale
+    ``state.validators`` replacement or a copy() (which drops instance
+    extras) safely rebuilds."""
+    validators = state.validators
+    tag = state.__dict__.get("_pk_index_tag")
+    m = state.__dict__.get("_pk_index")
+    if m is None or tag is None or tag[0] != id(validators) \
+            or tag[1] > len(validators):
+        m = {v.pubkey: i for i, v in enumerate(validators)}
+    else:
+        for i in range(tag[1], len(validators)):
+            m[validators[i].pubkey] = i
+    state.__dict__["_pk_index"] = m
+    state.__dict__["_pk_index_tag"] = (id(validators), len(validators))
+    return m
+
+
 def process_deposit(state, deposit) -> None:
     from ..proto import DEPOSIT_CONTRACT_TREE_DEPTH
 
@@ -258,7 +279,7 @@ def process_deposit(state, deposit) -> None:
 
     pubkey = deposit.data.pubkey
     amount = deposit.data.amount
-    known = {v.pubkey: i for i, v in enumerate(state.validators)}
+    known = pubkey_index_map(state)
     if pubkey not in known:
         # proof of possession: invalid signature -> deposit skipped
         message = DepositMessage(

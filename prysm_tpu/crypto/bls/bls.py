@@ -365,28 +365,43 @@ def deterministic_keypair(index: int) -> tuple[SecretKey, PublicKey]:
 # --- bench / driver hooks --------------------------------------------------
 
 
+# Bump when the on-disk array layout changes (limb packing, point
+# layout, field ordering): the filename token invalidates stale
+# .bench_cache entries that would otherwise silently feed wrong-format
+# arrays into the metric-of-record benchmark.
+_SLOT_CACHE_FORMAT = "v2_r16x24"
+
+
 def build_synthetic_slot_batch(n_committees: int, committee_size: int,
-                               cache_dir: str | None = None):
+                               cache_dir: str | None = None,
+                               rlc_bits: int = 64):
     """A synthetic mainnet slot: one aggregated attestation signature
     per committee over a distinct 32-byte root (deterministic keys).
 
     The pure-python point derivation for 12.8k keys costs ~tens of
     minutes of host CPU, so the packed device arrays are cached on
     disk (keyed by the deterministic construction parameters) — bench
-    reruns then skip straight to the dispatch under test."""
+    reruns then skip straight to the dispatch under test.
+
+    ``rlc_bits`` sets the random-linear-combination scalar width: 64
+    for production-strength batch verification (bench default), small
+    (e.g. 8) for structural dryruns/tests where compile time matters
+    more than soundness margin."""
     import os
 
     import jax.numpy as jnp
 
-    from .xla import h2c
     from .xla.curve import pack_g1_points, pack_g2_points
     from .xla.verify import random_rlc_bits
 
     cache_dir = cache_dir or os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))), ".bench_cache")
+    suffix = "" if rlc_bits == 64 else f"r{rlc_bits}"
     cache_path = os.path.join(
-        cache_dir, f"slot_{n_committees}x{committee_size}.npz")
+        cache_dir,
+        f"slot_{_SLOT_CACHE_FORMAT}_{n_committees}x{committee_size}"
+        f"{suffix}.npz")
     if os.path.exists(cache_path):
         try:
             z = np.load(cache_path)
@@ -403,7 +418,7 @@ def build_synthetic_slot_batch(n_committees: int, committee_size: int,
         except Exception:
             os.remove(cache_path)   # truncated/corrupt: regenerate
 
-    pk_pts, sig_pts, msgs = [], [], []
+    pk_pts, sig_pts, h_pts = [], [], []
     for c in range(n_committees):
         msg = hashlib.sha256(b"attestation-root-%d" % c).digest()
         sks = [ps.deterministic_secret_key(c * committee_size + i)
@@ -417,7 +432,7 @@ def build_synthetic_slot_batch(n_committees: int, committee_size: int,
         hpt = pure_h2g2(msg, ETH2_DST)
         sig_pts.append(pc.multiply(hpt, total))
         pk_pts.append([ps.sk_to_pubkey_point(sk) for sk in sks])
-        msgs.append(msg)
+        h_pts.append(hpt)
 
     flat_pks = [p for row in pk_pts for p in row]
     pk_jac = pack_g1_points(flat_pks)
@@ -425,8 +440,12 @@ def build_synthetic_slot_batch(n_committees: int, committee_size: int,
         t.reshape((n_committees, committee_size) + t.shape[1:])
         for t in pk_jac)
     sig_jac = pack_g2_points(sig_pts)
-    h_jac = h2c.hash_to_g2(msgs, ETH2_DST)
-    r_bits = random_rlc_bits(n_committees, np.random.default_rng(7))
+    # H(m) was already derived by the pure model above; packing it
+    # directly (affine, Z=1) avoids compiling the device h2c graphs in
+    # processes that only need a slot batch (the multichip dryrun).
+    h_jac = pack_g2_points(h_pts)
+    r_bits = random_rlc_bits(n_committees, np.random.default_rng(7),
+                             nbits=rlc_bits)
     try:
         os.makedirs(cache_dir, exist_ok=True)
         # write-then-rename: an interrupted write must not leave a
@@ -510,18 +529,20 @@ def graft_entry_fn():
 
 def dryrun_slot_pipeline(mesh) -> None:
     """Driver contract: jit the slot pipeline over a device mesh (data
-    parallel over the committee axis) and run one tiny step."""
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as Pspec
+    parallel over the committee axis) and run one tiny step.
 
-    from .xla import tower as xtower
+    Shapes are the structural minimum (one 2-validator committee per
+    device, 8-bit RLC scalars) so a COLD compile fits the driver's
+    budget on a 1-core host.  ``tests/test_multichip.py`` validates
+    the same graphs semantically; cache-wise the driver dryrun
+    compiles under ``fast_compile`` (separate cache entries from the
+    suite's), so the warm path for the driver is ``make warm-cache``,
+    whose final step runs this dryrun itself."""
     from .xla.verify import sharded_slot_verify
 
     n_dev = mesh.devices.size
-    batch = build_synthetic_slot_batch(n_committees=n_dev * 2,
-                                       committee_size=2)
+    batch = build_synthetic_slot_batch(n_committees=n_dev,
+                                       committee_size=2, rlc_bits=8)
     ok = sharded_slot_verify(mesh, batch["pk_jac"], batch["sig_jac"],
                              batch["h_jac"], batch["r_bits"])
     assert bool(ok), "sharded slot verification rejected a valid slot"

@@ -117,6 +117,43 @@ def point_add(ops: FieldOps, p1, p2):
     return out
 
 
+def point_add_unequal(ops: FieldOps, p1, p2):
+    """add-2007-bl with infinity selects but WITHOUT the P==Q doubling
+    branch (saves the embedded point_double — ~1/3 of the add cost).
+
+    Precondition: p1 != p2 unless one of them is infinity.  Safe for
+    windowed scalar-mul accumulation with sub-64-bit scalars (the
+    accumulator holds [16k]P, the addend [d]P with d < 16 and
+    16k + d << r, so the two are never the same finite point) and for
+    small-multiple table building ([d]P == P only if [d-1]P is
+    infinity)."""
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    Z1Z1 = ops.sqr(Z1)
+    Z2Z2 = ops.sqr(Z2)
+    U1 = ops.mul(X1, Z2Z2)
+    U2 = ops.mul(X2, Z1Z1)
+    S1 = ops.mul(ops.mul(Y1, Z2), Z2Z2)
+    S2 = ops.mul(ops.mul(Y2, Z1), Z1Z1)
+    H = ops.sub(U2, U1)
+    I = ops.sqr(ops.mul_small(H, 2))
+    J = ops.mul(H, I)
+    r = ops.mul_small(ops.sub(S2, S1), 2)
+    V = ops.mul(U1, I)
+    X3 = ops.sub(ops.sub(ops.sqr(r), J), ops.mul_small(V, 2))
+    Y3 = ops.sub(ops.mul(r, ops.sub(V, X3)),
+                 ops.mul_small(ops.mul(S1, J), 2))
+    Z3 = ops.mul(ops.mul_small(ops.mul(Z1, Z2), 2), H)
+    out = (X3, Y3, Z3)
+
+    p1_inf = ops.is_zero(Z1)
+    p2_inf = ops.is_zero(Z2)
+    out = tuple(ops.select(p1_inf, b, o) for b, o in zip(p2, out))
+    out = tuple(ops.select(p2_inf & ~p1_inf, a, o)
+                for a, o in zip(p1, out))
+    return out
+
+
 def point_neg(ops: FieldOps, pt):
     X, Y, Z = pt
     return (X, ops.neg(Y), Z)
@@ -145,6 +182,62 @@ def scalar_mul(ops: FieldOps, pt, scalar_bits):
 
     inf = point_inf_like(ops, pt)
     out, _ = lax.scan(body, inf, scalar_bits)
+    return out
+
+
+_WINDOW = 4
+
+
+def scalar_mul_windowed(ops: FieldOps, pt, scalar_bits):
+    """[k]P via fixed 4-bit windows — the RLC scalar-mul fast path.
+
+    scalar_bits: uint32[nbits, ...] MSB-first (nbits must be a
+    multiple of 4).  vs the double-always/add-always ladder this runs
+    nbits doublings but only nbits/4 adds: a 16-entry table of small
+    multiples [d]P is built once (7 doublings + 7 unequal adds), and
+    each window step does 4 doublings + a one-hot table contraction +
+    one unequal add.  The one-hot sum is exact in uint32 (single
+    nonzero term) and vectorizes over the batch — no gather.
+
+    Precondition (inherited from point_add_unequal): scalars below
+    ~2^64 so the accumulator can never collide with a table entry.
+    Production RLC scalars are 64-bit; do NOT use this for general
+    255-bit scalars without an exceptional-case audit."""
+    nbits = scalar_bits.shape[0]
+    assert nbits % _WINDOW == 0, "bit count must be a window multiple"
+    nwin = nbits // _WINDOW
+
+    # table[d] = [d]P: even entries by doubling, odd by unequal add
+    tbl = [point_inf_like(ops, pt), pt]
+    for d in range(2, 1 << _WINDOW):
+        if d % 2 == 0:
+            tbl.append(point_double(ops, tbl[d // 2]))
+        else:
+            tbl.append(point_add_unequal(ops, tbl[d - 1], pt))
+    table = tuple(jnp.stack([t[i] for t in tbl], axis=0)
+                  for i in range(3))                 # (16, ..., limbs)
+
+    # bit planes -> window digits (nwin, ...)
+    w = scalar_bits.reshape((nwin, _WINDOW) + scalar_bits.shape[1:])
+    digits = jnp.zeros_like(w[:, 0])
+    for i in range(_WINDOW):
+        digits = (digits << 1) | w[:, i]
+
+    def body(acc, digit):
+        for _ in range(_WINDOW):
+            acc = point_double(ops, acc)
+        # digit: (batch...) -> (1, batch..., 1[, 1]) aligned with the
+        # table's (16, batch..., [2,] limbs)
+        d = jnp.expand_dims(digit, tuple(range(-ops.ndims, 0)))[None]
+        dvals = jnp.arange(1 << _WINDOW, dtype=jnp.uint32).reshape(
+            (1 << _WINDOW,) + (1,) * (d.ndim - 1))
+        onehot = (d == dvals).astype(jnp.uint32)
+        entry = tuple(jnp.sum(t * onehot, axis=0) for t in table)
+        acc = point_add_unequal(ops, acc, entry)
+        return acc, None
+
+    inf = point_inf_like(ops, pt)
+    out, _ = lax.scan(body, inf, digits)
     return out
 
 

@@ -209,12 +209,17 @@ def final_exponentiation(f):
 def _pow_abs_x(f):
     """f^|x| as one lax.scan over the 63 post-leading bits (X_BITS is
     the module's single source for the |x| bit pattern — shared with
-    the Miller loop)."""
+    the Miller loop).  |x| has Hamming weight 6, so running the
+    multiply under ``lax.cond`` (one branch executes) makes 57 of the
+    63 steps squaring-only — this scan appears five times in series in
+    the check final exponentiation, so halving its step cost is a
+    first-order latency win."""
     bits = jnp.asarray(np.array(X_BITS, dtype=np.uint32))
 
     def body(acc, bit):
         acc = T.fq12_sqr(acc)
-        acc = T.fq12_select(bit == 1, T.fq12_mul(acc, f), acc)
+        acc = lax.cond(bit == 1, lambda a: T.fq12_mul(a, f),
+                       lambda a: a, acc)
         return acc, None
 
     out, _ = lax.scan(body, f, bits)

@@ -1,0 +1,145 @@
+"""Shared in-kernel field arithmetic for the Pallas TPU tier.
+
+Reference analog: blst's assembly field layer [U, SURVEY.md §2 L0] —
+here as composable helpers that Pallas kernels (``pallas_mont``,
+``pallas_tower``) call on VMEM-resident tiles, so whole tower
+operations fuse into single kernels and the redundant column
+intermediates never touch HBM.
+
+Layout: one field element is a ``(24, B)`` uint32 tile — limbs on the
+sublane axis, batch elements on the lane axis (same transposed layout
+as the Pallas SHA-256 kernel).  All limb loops are Python-unrolled;
+carry propagation is LOG-depth (fold + Kogge–Stone prefix over the
+sublane axis — the round-2 ``limbs._carry_resolve`` rewrite, ported
+here per VERDICT r2 #3: the previous kernel rippled carries through
+24 sequential single-sublane steps, three times per multiply).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import limbs as L
+
+_RADIX = np.uint32(1 << L.RADIX_BITS)
+_MASK = np.uint32((1 << L.RADIX_BITS) - 1)
+_SHIFT = np.uint32(L.RADIX_BITS)
+
+
+def shift_up(x, k: int = 1, fill: int = 0):
+    """out[i] = x[i-k] along the limb (sublane) axis."""
+    if k == 0:
+        return x
+    pad = jnp.full((k,) + x.shape[1:], fill, dtype=x.dtype)
+    return jnp.concatenate([pad, x[:-k]], axis=0)
+
+
+def carry_resolve(x, n: int):
+    """Exact carry propagation in log depth (entries <= 2**16 — i.e.
+    at most one pending carry each).  Kogge–Stone generate/propagate
+    prefix over the limb axis; returns (canonical limbs, carry-out of
+    the top limb)."""
+    g = x >> _SHIFT                          # 0/1
+    p = ((x & _MASK) == _MASK).astype(jnp.uint32)
+    shift = 1
+    while shift < n:
+        g = g | (p & shift_up(g, shift))
+        p = p & shift_up(p, shift, fill=1)
+        shift *= 2
+    carry_in = shift_up(g)                   # c[i] = G[i-1], c[0] = 0
+    out = (x + carry_in) & _MASK
+    return out, g[-1]
+
+
+def carry_norm(cols, n_out: int):
+    """Redundant columns (entries < 2**26) -> canonical 16-bit limbs
+    (n_out, B); carries past n_out drop (mod 2**(16*n_out)).  Two fold
+    passes squeeze to one pending carry, then the log-depth resolve."""
+    x = cols[:n_out]
+    for _ in range(2):
+        x = (x & _MASK) + shift_up(x >> _SHIFT)
+    out, _ = carry_resolve(x, n_out)
+    return out
+
+
+def mul_columns(a, b, low_only: bool = False):
+    """Schoolbook product of (24, B) operands as redundant columns:
+    (48, B), or (24, B) for the low half.  Entries < 2**21.6."""
+    n = L.NLIMBS
+    width = n if low_only else 2 * n
+    cols = jnp.zeros((width,) + a.shape[1:], dtype=jnp.uint32)
+    for i in range(n):
+        p = a[i][None, :] * b                   # (24, B) uint32, exact
+        lo = p & _MASK
+        hi = p >> _SHIFT
+        if low_only:
+            cols = cols + jnp.pad(lo[:n - i], ((i, 0), (0, 0)))
+            if i + 1 < n:
+                cols = cols + jnp.pad(hi[:n - i - 1], ((i + 1, 0), (0, 0)))
+        else:
+            cols = cols + jnp.pad(lo, ((i, n - i), (0, 0)))
+            cols = cols + jnp.pad(hi, ((i + 1, n - i - 1), (0, 0)))
+    return cols
+
+
+def sub_borrow(a, b):
+    """a - b mod 2**384 with borrow flag, via two's complement + the
+    log-depth resolver (a, b: (24, B))."""
+    s = a + (_MASK - b)                      # entries <= 2**17 - 2
+    one = jnp.concatenate(
+        [jnp.ones((1,) + s.shape[1:], jnp.uint32),
+         jnp.zeros((L.NLIMBS - 1,) + s.shape[1:], jnp.uint32)], axis=0)
+    s = s + one
+    hi = s >> _SHIFT
+    top_carry = hi[-1]
+    s = (s & _MASK) + shift_up(hi)
+    diff, carry_out = carry_resolve(s, L.NLIMBS)
+    return diff, jnp.uint32(1) - (top_carry | carry_out)
+
+
+def csub_p(x, p):
+    """Canonicalize a value < 2P by one conditional subtract."""
+    diff, borrow = sub_borrow(x, p)
+    return jnp.where((borrow == 0)[None, :], diff, x)
+
+
+def fp_add(a, b, p):
+    s = a + b
+    s = (s & _MASK) + shift_up(s >> _SHIFT)
+    out, _ = carry_resolve(s, L.NLIMBS)
+    return csub_p(out, p)
+
+
+def fp_sub(a, b, p):
+    d, borrow = sub_borrow(a, b)
+    wrapped = d + p
+    wrapped = (wrapped & _MASK) + shift_up(wrapped >> _SHIFT)
+    wrapped, _ = carry_resolve(wrapped, L.NLIMBS)
+    return jnp.where((borrow == 1)[None, :], wrapped, d)
+
+
+def fp_neg(a, p):
+    """P - a, with -0 = 0 (exact fp_neg semantics)."""
+    diff, _ = sub_borrow(jnp.broadcast_to(p, a.shape), a)
+    is_zero = jnp.all(a == 0, axis=0)
+    return jnp.where(is_zero[None, :], a, diff)
+
+
+def mont_reduce(cols, p, npr):
+    """48 redundant product columns -> canonical 24 limbs, product-form
+    Montgomery (same math as limbs._mont_reduce).  ``cols`` may be a
+    SUM of up to ~16 schoolbook products (lazy reduction): entries
+    must stay < 2**26 - 2**22 so the mp addition keeps the fold bound."""
+    t_lo = carry_norm(cols, L.NLIMBS)
+    m = carry_norm(mul_columns(t_lo, npr, low_only=True), L.NLIMBS)
+    mp = mul_columns(m, p)
+    total = cols + mp
+    limbs = carry_norm(total, 2 * L.NLIMBS)[L.NLIMBS:]
+    return csub_p(limbs, p)
+
+
+def mont_mul(a, b, p, npr):
+    """Full fused Montgomery multiply of (24, B) tiles."""
+    return mont_reduce(mul_columns(a, b), p, npr)

@@ -1,0 +1,195 @@
+"""Fused Pallas TPU kernels for Fq12 tower multiplication.
+
+Reference analog: blst's fp12 tower arithmetic [U, SURVEY.md §2 L0].
+Where the XLA tier builds an Fq12 multiply from ~54 separately
+reduced Montgomery multiplies (tower.py Karatsuba stacking), this
+kernel computes every output Fp coefficient by LAZY REDUCTION: the
+whole Fq12 product expands symbolically (at trace time) into signed
+Fp schoolbook products, whose redundant 48-column forms accumulate in
+VMEM and Montgomery-reduce ONCE per output coefficient — 12
+reductions instead of 54, no intermediate normalizations, and one
+kernel launch instead of hundreds of HLO ops.
+
+Math notes:
+
+* Signs fold into the operands: a negative term x·(−y) becomes
+  x·(P−y) (with −0 = 0), so column accumulators stay unsigned.
+* ξ-scaled products (ξ = 1+u) use precomputed operand variants
+  d = y0−y1, s = y0+y1:  ξ(xy) = (x0·d − x1·s, x0·s + x1·d) — two
+  terms each, same as unscaled.  With w²=v, v³=ξ the fq12 schoolbook
+  needs no ξ² terms, so every output coefficient is a sum of ≤ 12
+  products < 12·P².  Montgomery's (T + M·P)/R then bounds the result
+  by 12P/8 + P < 3P: TWO trailing conditional subtracts canonicalize
+  (the single-product path needs one).
+* Layout: (12, 24, B) — Fp coefficients (w-major, then v, then u) ×
+  limbs × lanes; carries in log depth (pallas_field).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import limbs as L
+from . import pallas_field as F
+
+LANES = 128
+_BLOCK = 128            # fq12 elements per grid step
+
+# --- symbolic expansion (trace-time, python ints only) ----------------------
+
+# b-operand variants per Fq2 slot (c0, c1) — negations carry the sign,
+# d/s carry the xi factor
+_V_C0, _V_C1, _V_NC0, _V_NC1, _V_D, _V_S, _V_ND, _V_NS = range(8)
+
+
+def _fq2_slot_terms(t: int):
+    """Terms of xi^t * (x * y) per output Fq2 coefficient: lists of
+    (x coefficient index, y variant)."""
+    if t == 0:
+        return {0: [(0, _V_C0), (1, _V_NC1)],
+                1: [(0, _V_C1), (1, _V_C0)]}
+    if t == 1:
+        # xi*(z0, z1) = (z0 - z1, z0 + z1) pushed into the operands
+        return {0: [(0, _V_D), (1, _V_NS)],
+                1: [(0, _V_S), (1, _V_D)]}
+    raise AssertionError("xi^2 terms cannot appear in the fq12 product")
+
+
+def _fp_idx(h: int, k: int, j: int) -> int:
+    return (h * 3 + k) * 2 + j
+
+
+def _build_fq12_terms():
+    """TERMS[out_fp_idx] = [(a_fp_idx, b_fq2_slot, variant), ...]."""
+    terms = {o: [] for o in range(12)}
+    for h1 in range(2):
+        for k1 in range(3):
+            for h2 in range(2):
+                for k2 in range(3):
+                    h, k, t = h1 + h2, k1 + k2, 0
+                    if h == 2:
+                        h, k = 0, k + 1
+                    if k >= 3:
+                        k, t = k - 3, t + 1
+                    slot_b = h2 * 3 + k2
+                    for out_j, lst in _fq2_slot_terms(t).items():
+                        for (ja, var) in lst:
+                            terms[_fp_idx(h, k, out_j)].append(
+                                (_fp_idx(h1, k1, ja), slot_b, var))
+    assert max(len(v) for v in terms.values()) <= 12
+    return terms
+
+
+_FQ12_TERMS = _build_fq12_terms()
+
+
+# --- kernel ----------------------------------------------------------------
+
+
+def _fq12_mul_kernel(p_ref, np_ref, a_ref, b_ref, o_ref):
+    a = a_ref[:]                                # (12, 24, B)
+    b = b_ref[:]
+    width = a.shape[2]
+    p = jnp.broadcast_to(p_ref[:][:, None], (L.NLIMBS, width))
+    npr = jnp.broadcast_to(np_ref[:][:, None], (L.NLIMBS, width))
+
+    avs = [a[i] for i in range(12)]
+    bvs = [b[i] for i in range(12)]
+
+    variant_cache: dict = {}
+
+    def b_variant(slot: int, var: int):
+        key = (slot, var)
+        got = variant_cache.get(key)
+        if got is not None:
+            return got
+        c0, c1 = bvs[2 * slot], bvs[2 * slot + 1]
+        if var == _V_C0:
+            v = c0
+        elif var == _V_C1:
+            v = c1
+        elif var == _V_NC0:
+            v = F.fp_neg(c0, p)
+        elif var == _V_NC1:
+            v = F.fp_neg(c1, p)
+        elif var == _V_D:
+            v = F.fp_sub(c0, c1, p)
+        elif var == _V_S:
+            v = F.fp_add(c0, c1, p)
+        elif var == _V_ND:
+            v = F.fp_sub(c1, c0, p)
+        else:
+            v = F.fp_neg(F.fp_add(c0, c1, p), p)
+        variant_cache[key] = v
+        return v
+
+    prod_cache: dict = {}
+
+    def prod(i: int, slot: int, var: int):
+        key = (i, slot, var)
+        got = prod_cache.get(key)
+        if got is None:
+            got = F.mul_columns(avs[i], b_variant(slot, var))
+            prod_cache[key] = got
+        return got
+
+    outs = []
+    for o in range(12):
+        cols = None
+        for (i, slot, var) in _FQ12_TERMS[o]:
+            t = prod(i, slot, var)
+            cols = t if cols is None else cols + t
+        red = F.mont_reduce(cols, p, npr)
+        outs.append(F.csub_p(red, p))           # lazy sums bound < 3P
+    o_ref[:] = jnp.stack(outs)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _fq12_mul_flat(a_t, b_t, interpret: bool):
+    """(12, 24, n) x (12, 24, n) -> (12, 24, n); n % LANES == 0."""
+    n = a_t.shape[2]
+    block = _BLOCK if n % _BLOCK == 0 else LANES
+    return pl.pallas_call(
+        _fq12_mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((12, L.NLIMBS, n), jnp.uint32),
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((L.NLIMBS,), lambda i: (0,)),
+            pl.BlockSpec((L.NLIMBS,), lambda i: (0,)),
+            pl.BlockSpec((12, L.NLIMBS, block), lambda i: (0, 0, i)),
+            pl.BlockSpec((12, L.NLIMBS, block), lambda i: (0, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((12, L.NLIMBS, block),
+                               lambda i: (0, 0, i)),
+        interpret=interpret,
+    )(jnp.asarray(L.P_LIMBS), jnp.asarray(L.NPRIME_LIMBS), a_t, b_t)
+
+
+def fq12_mul_pallas(a, b, interpret: bool | None = None):
+    """Drop-in for tower.fq12_mul: (..., 2, 3, 2, 24) uint32 operands."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    batch = int(np.prod(shape[:-4], dtype=np.int64)) \
+        if len(shape) > 4 else 1
+    fa = jnp.moveaxis(a.reshape(batch, 12, L.NLIMBS), 0, -1)
+    fb = jnp.moveaxis(b.reshape(batch, 12, L.NLIMBS), 0, -1)
+    n_pad = -(-batch // LANES) * LANES
+    if n_pad != batch:
+        pad = ((0, 0), (0, 0), (0, n_pad - batch))
+        fa = jnp.pad(fa, pad)
+        fb = jnp.pad(fb, pad)
+    out = _fq12_mul_flat(fa, fb, bool(interpret))
+    return jnp.moveaxis(out, -1, 0)[:batch].reshape(shape)
+
+
+def fq12_sqr_pallas(a, interpret: bool | None = None):
+    return fq12_mul_pallas(a, a, interpret=interpret)

@@ -275,7 +275,13 @@ def fq12_sub(a, b):
 @jax.jit
 def fq12_mul(a, b):
     """Karatsuba over Fq6: 3 Fq6 muls -> one stacked call (54 Fp muls
-    total in a single batched Montgomery multiply)."""
+    total in a single batched Montgomery multiply).  The pallas
+    backend routes to the FUSED lazy-reduction kernel instead (one
+    launch, 12 Montgomery reductions — pallas_tower.py)."""
+    if L.get_mul_backend() == "pallas":
+        from .pallas_tower import fq12_mul_pallas
+
+        return fq12_mul_pallas(a, b)
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
     la = jnp.stack([a0, a1, fq6_add(a0, a1)], axis=-4)
@@ -289,7 +295,12 @@ def fq12_mul(a, b):
 
 @jax.jit
 def fq12_sqr(a):
-    """Complex-style squaring: 2 Fq6 muls in one stacked call."""
+    """Complex-style squaring: 2 Fq6 muls in one stacked call (pallas
+    backend: one fused kernel launch)."""
+    if L.get_mul_backend() == "pallas":
+        from .pallas_tower import fq12_sqr_pallas
+
+        return fq12_sqr_pallas(a)
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     la = jnp.stack([fq6_add(a0, a1), a0], axis=-4)
     lb = jnp.stack([fq6_add(a0, fq6_mul_by_v(a1)), a1], axis=-4)

@@ -29,8 +29,8 @@ from . import limbs as L
 from . import tower as T
 from .curve import (
     FP_OPS, FQ2_OPS, g1_to_affine, g2_to_affine, pack_g1_points,
-    point_sum_tree, scalar_mul, scalar_bits_from_ints, point_select,
-    point_inf_like,
+    point_sum_tree, scalar_mul, scalar_mul_windowed,
+    scalar_bits_from_ints, point_select, point_inf_like,
 )
 from .pairing import (
     final_exponentiation_check, fq12_prod_tree, is_fq12_one,
@@ -44,6 +44,31 @@ NEG_G1_GEN = (pc.G1_GEN[0], -pc.G1_GEN[1])
 def _neg_g1_affine():
     x, y, _ = pack_g1_points([NEG_G1_GEN])
     return x[0], y[0]
+
+
+def _batch_affine(g1_jac, g2_jac):
+    """Affine-convert a G1 batch and a G2 batch with ONE shared Fermat
+    inversion.  1/Z (Fp) is fp_inv(Z); 1/Z (Fq2) is
+    conj(Z)·fp_inv(norm Z) — so every inversion in a pairing-check
+    graph concatenates into a single 381-step square-and-multiply
+    scan.  Separate g1_to_affine/g2_to_affine calls each ran their own
+    scan, and those scans are the deepest sequential chains in the
+    slot-verify graph after the Miller loop."""
+    X1, Y1, Z1 = g1_jac                       # (n1, 24)
+    X2, Y2, Z2 = g2_jac                       # (n2, 2, 24)
+    n1 = Z1.shape[0]
+    norm = L.fp_add(L.fp_sqr(Z2[..., 0, :]), L.fp_sqr(Z2[..., 1, :]))
+    inv = L.fp_inv(jnp.concatenate([Z1, norm], axis=0))
+    z1inv, ninv = inv[:n1], inv[n1:]
+    zi2 = L.fp_sqr(z1inv)
+    ax = L.fp_mul(X1, zi2)
+    ay = L.fp_mul(Y1, L.fp_mul(zi2, z1inv))
+    z2inv = T.fq2_mul_fp(T.fq2_conj(Z2), ninv)
+    zi2q = T.fq2_sqr(z2inv)
+    bx = T.fq2_mul(X2, zi2q)
+    by = T.fq2_mul(Y2, T.fq2_mul(zi2q, z2inv))
+    return ((ax, ay, L.fp_is_zero(Z1)),
+            (bx, by, T.fq2_is_zero(Z2)))
 
 
 @jax.jit
@@ -101,23 +126,22 @@ def rlc_batch_verify_device(pk_jac, sig_jac, h_jac, r_bits, mask):
     r_bits: uint32 (nbits, n) random scalars (MSB-first);
     mask: bool (n,) — padding entries contribute nothing."""
     # [r_i] sig_i, summed -> S
-    r_sigs = scalar_mul(FQ2_OPS, sig_jac, r_bits)
+    r_sigs = scalar_mul_windowed(FQ2_OPS, sig_jac, r_bits)
     r_sigs = point_select(FQ2_OPS, mask, r_sigs,
                           point_inf_like(FQ2_OPS, r_sigs))
     s = point_sum_tree(FQ2_OPS, r_sigs)
-    sx, sy, s_inf = g2_to_affine(tuple(t[None] for t in s))
-    # [r_i] pk_i
-    r_pks = scalar_mul(FP_OPS, pk_jac, r_bits)
-    px, py, p_inf = g1_to_affine(r_pks)
-    hx, hy, _ = g2_to_affine(h_jac)
+    # [r_i] pk_i; one shared inversion for all affine conversions
+    r_pks = scalar_mul_windowed(FP_OPS, pk_jac, r_bits)
+    g2_all = tuple(jnp.concatenate([t_s[None], t_h], axis=0)
+                   for t_s, t_h in zip(s, h_jac))
+    (px, py, p_inf), (qx, qy, q_inf) = _batch_affine(r_pks, g2_all)
+    s_inf = q_inf[:1]
 
     ng_x, ng_y = _neg_g1_affine()
     p_x = jnp.concatenate([ng_x[None], px], axis=0)
     p_y = jnp.concatenate([ng_y[None], py], axis=0)
-    q_x = jnp.concatenate([sx, hx], axis=0)
-    q_y = jnp.concatenate([sy, hy], axis=0)
     full_mask = jnp.concatenate([~s_inf, mask & ~p_inf], axis=0)
-    return _pairing_check(p_x, p_y, q_x, q_y, full_mask)
+    return _pairing_check(p_x, p_y, qx, qy, full_mask)
 
 
 @jax.jit
@@ -131,21 +155,20 @@ def slot_verify_device(pk_jac, sig_jac, h_jac, r_bits):
     # per-committee aggregate pubkey: tree-sum over the validator axis
     pk_t = tuple(jnp.moveaxis(t, 1, 0) for t in pk_jac)   # (K, C, ...)
     apk = point_sum_tree(FP_OPS, pk_t)                    # (C, ...)
-    # RLC
-    r_apk = scalar_mul(FP_OPS, apk, r_bits)
-    r_sig = scalar_mul(FQ2_OPS, sig_jac, r_bits)
+    # RLC (4-bit windowed: nbits doublings, nbits/4 adds)
+    r_apk = scalar_mul_windowed(FP_OPS, apk, r_bits)
+    r_sig = scalar_mul_windowed(FQ2_OPS, sig_jac, r_bits)
     s = point_sum_tree(FQ2_OPS, r_sig)
-    # affine + pairing
-    ax, ay, a_inf = g1_to_affine(r_apk)
-    sx, sy, s_inf = g2_to_affine(tuple(t[None] for t in s))
-    hx, hy, _ = g2_to_affine(h_jac)
+    # affine (one shared Fermat scan for all of r_apk, S, H) + pairing
+    g2_all = tuple(jnp.concatenate([t_s[None], t_h], axis=0)
+                   for t_s, t_h in zip(s, h_jac))
+    (ax, ay, a_inf), (qx, qy, q_inf) = _batch_affine(r_apk, g2_all)
+    s_inf = q_inf[:1]
     ng_x, ng_y = _neg_g1_affine()
     p_x = jnp.concatenate([ng_x[None], ax], axis=0)
     p_y = jnp.concatenate([ng_y[None], ay], axis=0)
-    q_x = jnp.concatenate([sx, hx], axis=0)
-    q_y = jnp.concatenate([sy, hy], axis=0)
     mask = jnp.concatenate([~s_inf, ~a_inf], axis=0)
-    return _pairing_check(p_x, p_y, q_x, q_y, mask)
+    return _pairing_check(p_x, p_y, qx, qy, mask)
 
 
 def sharded_slot_verify(mesh, pk_jac, sig_jac, h_jac, r_bits):
@@ -160,11 +183,10 @@ def sharded_slot_verify(mesh, pk_jac, sig_jac, h_jac, r_bits):
     def local_work(pk, sig, h, rb):
         # pk arrives as (K, C_local, ...): sum over the validator axis
         apk = point_sum_tree(FP_OPS, pk)
-        r_apk = scalar_mul(FP_OPS, apk, rb)
-        r_sig = scalar_mul(FQ2_OPS, sig, rb)
+        r_apk = scalar_mul_windowed(FP_OPS, apk, rb)
+        r_sig = scalar_mul_windowed(FQ2_OPS, sig, rb)
         s_part = point_sum_tree(FQ2_OPS, r_sig)
-        ax, ay, a_inf = g1_to_affine(r_apk)
-        hx, hy, _ = g2_to_affine(h)
+        (ax, ay, a_inf), (hx, hy, _) = _batch_affine(r_apk, h)
         f = miller_loop((ax, ay), (hx, hy))
         f = T.fq12_select(~a_inf, f, T.fq12_one_like(f))
         f_part = fq12_prod_tree(f)
@@ -189,8 +211,14 @@ def sharded_slot_verify(mesh, pk_jac, sig_jac, h_jac, r_bits):
 
 
 def random_rlc_bits(n: int, rng=None, nbits: int = 64) -> jnp.ndarray:
-    """n random nonzero RLC scalars as MSB-first bit planes."""
+    """n random nonzero RLC scalars as MSB-first bit planes.
+
+    ``nbits`` is the soundness parameter (2^-nbits+1 forgery odds for
+    the batch); 64 is the production width, small widths serve
+    structural dryruns/tests where the scan length dominates compile
+    time."""
     if rng is None:
         rng = np.random.default_rng()
-    scalars = [int(rng.integers(1, 1 << 63)) | 1 for _ in range(n)]
+    hi = 1 << min(nbits, 63)
+    scalars = [int(rng.integers(1, hi)) | 1 for _ in range(n)]
     return scalar_bits_from_ints(scalars, nbits)

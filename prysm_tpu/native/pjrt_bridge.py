@@ -195,7 +195,15 @@ class PjrtBridgeClient:
         for i, arr in enumerate(inputs):
             if arr.dtype == np.uint32:
                 dtypes[i] = 0
-            elif arr.dtype == np.bool_ or arr.dtype == np.uint8:
+            elif arr.dtype == np.bool_:
+                dtypes[i] = 1
+            elif arr.dtype == np.uint8:
+                # PRED is 0/1 only; a general uint8 buffer would be
+                # silently misdeclared to the plugin as booleans
+                if arr.size and int(arr.max(initial=0)) > 1:
+                    raise ValueError(
+                        "uint8 input has values > 1; PRED inputs must "
+                        "be 0/1 (pass np.bool_ instead)")
                 dtypes[i] = 1
             else:
                 raise ValueError(f"unsupported input dtype {arr.dtype}")

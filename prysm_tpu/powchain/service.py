@@ -137,12 +137,17 @@ class PowchainService:
         valid_datas = [to_data(b) for b in valid]
         votes = [v for v in state.eth1_data_votes if v in valid_datas]
         if votes:
-            # majority vote, ties broken by order of appearance
-            best, best_n = None, 0
+            # spec max(valid_votes, key=(count, -index)): majority
+            # vote, count ties broken by EARLIEST occurrence in
+            # state.eth1_data_votes
+            best, best_key = None, (0, 0)
             for v in valid_datas:
                 n = votes.count(v)
-                if n > best_n:
-                    best, best_n = v, n
+                if n == 0:
+                    continue
+                key = (n, -state.eth1_data_votes.index(v))
+                if key > best_key:
+                    best, best_key = v, key
             if best is not None:
                 return best
         return valid_datas[-1]
@@ -160,11 +165,21 @@ class PowchainService:
         processed."""
         cfg = beacon_config()
         eth1_data = eth1_data or state.eth1_data
-        target = min(eth1_data.deposit_count,
-                     len(self.eth1.deposit_datas))
+        target = eth1_data.deposit_count
         start = state.eth1_deposit_index
         if start >= target:
+            # nothing owed for this block — a lagging follower is
+            # irrelevant here, so don't fail the proposal
             return []
+        if len(self.eth1.deposit_datas) < target:
+            # producing a block with fewer deposits than
+            # process_operations' expected-deposit count would have the
+            # node reject its OWN block — refuse loudly instead of
+            # silently truncating
+            raise RuntimeError(
+                f"eth1 follower is behind: have "
+                f"{len(self.eth1.deposit_datas)} deposits, effective "
+                f"eth1_data requires {target}")
         n = min(cfg.max_deposits, target - start)
         if self._snapshot_count != target or self._snapshot is None:
             snapshot = DepositTree()

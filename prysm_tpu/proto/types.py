@@ -291,6 +291,15 @@ def build_types(cfg: BeaconChainConfig) -> SimpleNamespace:
             ("finalized_checkpoint", Checkpoint),
         ]
 
+        @classmethod
+        def hash_tree_root(cls, value) -> bytes:
+            # dirty-field caching: diff-based incremental tries for
+            # the registry/vector fields (state/htr_cache.py) — the
+            # reference's stateutil per-field root cache analog
+            from ..state.htr_cache import state_hash_tree_root
+
+            return state_hash_tree_root(cls, value)
+
     ns = SimpleNamespace(
         BeaconBlockBody=BeaconBlockBody,
         BeaconBlock=BeaconBlock,

@@ -61,34 +61,46 @@ class ValidatorAPI:
         if state.slot < start:
             process_slots(state, start, self.node.types)
 
-        index_by_pk = {v.pubkey: i
-                       for i, v in enumerate(state.validators)}
+        from ..core.transition import pubkey_index_map
+
+        index_by_pk = pubkey_index_map(state)
         duties: dict[int, Duty] = {}
-        wanted = {pk: index_by_pk.get(pk) for pk in pubkeys}
+        # invert the lookup: walk every committee member once and test
+        # membership in the requested set — O(active validators) per
+        # epoch total, independent of how many pubkeys are asked for
+        # (the old per-committee scan over `wanted` was
+        # O(requested x active))
+        wanted_by_index = {index_by_pk[pk]: pk for pk in pubkeys
+                           if pk in index_by_pk}
         count = get_committee_count_per_slot(state, epoch)
         for slot in range(start, start + cfg.slots_per_epoch):
             for ci in range(count):
                 committee = get_beacon_committee(state, slot, ci)
-                for pk, vi in wanted.items():
-                    if vi in committee:
+                for vi in committee:
+                    pk = wanted_by_index.get(vi)
+                    if pk is not None:
                         duties[vi] = Duty(
                             pubkey=pk, validator_index=vi,
                             committee=committee, committee_index=ci,
                             attester_slot=slot)
-        # proposer slots need per-slot state advancement
-        work = state.copy()
+        # proposer slots: epoch seed + active set are epoch-constant,
+        # so every slot's proposer resolves from the ONE epoch-start
+        # state (no per-slot state copies/advancement)
+        from ..core.helpers import get_beacon_proposer_index_at_slot
+
         for slot in range(max(start, 1), start + cfg.slots_per_epoch):
-            if work.slot < slot:
-                process_slots(work, slot, self.node.types)
-            proposer = get_beacon_proposer_index(work)
-            for pk, vi in wanted.items():
-                if vi == proposer and vi in duties:
-                    duties[vi].proposer_slots.append(slot)
-                elif vi == proposer:
-                    duties[vi] = Duty(pubkey=pk, validator_index=vi,
-                                      committee=[], committee_index=0,
-                                      attester_slot=-1,
-                                      proposer_slots=[slot])
+            proposer = get_beacon_proposer_index_at_slot(state, slot)
+            pk = wanted_by_index.get(proposer)
+            if pk is None:
+                continue
+            if proposer in duties:
+                duties[proposer].proposer_slots.append(slot)
+            else:
+                duties[proposer] = Duty(pubkey=pk,
+                                        validator_index=proposer,
+                                        committee=[], committee_index=0,
+                                        attester_slot=-1,
+                                        proposer_slots=[slot])
         return list(duties.values())
 
     # --- block production --------------------------------------------------

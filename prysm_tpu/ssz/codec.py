@@ -486,28 +486,30 @@ class Container(SSZType):
         return cls(**values)
 
     # subclasses with ONLY scalar/bytes fields may set root_memo=True:
-    # roots are memoized on the value tuple (the reference caches
-    # per-validator roots the same way in stateutil)
+    # the root caches ON THE INSTANCE and __setattr__ invalidates it
+    # (the reference caches per-validator roots with dirty flags in
+    # stateutil the same way).  Instance caching beats the previous
+    # value-tuple memo dict: no key construction per lookup, and the
+    # dirty-field state cache can read 500k validator leaves at
+    # attribute-access speed.
     root_memo = False
-    _memo: dict | None = None
+
+    def __setattr__(self, name, value):
+        d = self.__dict__
+        d[name] = value
+        if "_iroot" in d and name != "_iroot":
+            del d["_iroot"]
 
     @classmethod
     def hash_tree_root(cls, value) -> bytes:
         if cls.root_memo:
-            key = tuple(getattr(value, name) for name, _ in cls.fields)
-            memo = cls.__dict__.get("_memo")
-            if memo is None:
-                memo = {}
-                cls._memo = memo
-            cached = memo.get(key)
+            cached = value.__dict__.get("_iroot")
             if cached is not None:
                 return cached
-            roots = [typ.hash_tree_root(v)
-                     for (name, typ), v in zip(cls.fields, key)]
+            roots = [typ.hash_tree_root(getattr(value, name))
+                     for name, typ in cls.fields]
             root = merkleize_chunks(roots)
-            if len(memo) > 1 << 20:
-                memo.clear()
-            memo[key] = root
+            value.__dict__["_iroot"] = root
             return root
         roots = [typ.hash_tree_root(getattr(value, name))
                  for name, typ in cls.fields]
@@ -535,6 +537,9 @@ class Container(SSZType):
             elif isinstance(v, Container):
                 v = v.copy()
             setattr(new, name, v)
+        cached = self.__dict__.get("_iroot")
+        if cached is not None:
+            new.__dict__["_iroot"] = cached
         return new
 
     def __eq__(self, o):

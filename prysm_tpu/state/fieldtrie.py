@@ -27,6 +27,35 @@ def _h(a: bytes, b: bytes) -> bytes:
     return hashlib.sha256(a + b).digest()
 
 
+def _hash_level(lv: np.ndarray, level: int) -> np.ndarray:
+    """Hash one whole (n, 32) level into its (ceil(n/2), 32) parents —
+    batched through the JAX SHA-256 Merkleizer above the threshold,
+    hashlib below it."""
+    n = lv.shape[0]
+    n_par = (n + 1) // 2
+    if n_par == 0:
+        return np.zeros((0, 32), dtype=np.uint8)
+    if n_par < _BULK_THRESHOLD:
+        zero = ZERO_HASHES[level]
+        par = np.zeros((n_par, 32), dtype=np.uint8)
+        for p in range(n_par):
+            right = lv[2 * p + 1].tobytes() if 2 * p + 1 < n else zero
+            par[p] = np.frombuffer(_h(lv[2 * p].tobytes(), right),
+                                   dtype=np.uint8)
+        return par
+    from ..ssz import merkle_jax
+
+    if n % 2 == 1:
+        lv = np.concatenate(
+            [lv, np.frombuffer(ZERO_HASHES[level],
+                               dtype=np.uint8)[None]], axis=0)
+    words = np.frombuffer(lv.tobytes(), dtype=">u4").astype(
+        np.uint32).reshape(n_par, 16)
+    out = np.asarray(merkle_jax.hash_pairs(words))
+    return np.frombuffer(out.astype(">u4").tobytes(),
+                         dtype=np.uint8).reshape(n_par, 32)
+
+
 class FieldTrie:
     """Fixed-depth incremental Merkle tree over 32-byte leaves with a
     zero-subtree ladder, list-limit depth, and mix-in-length roots."""
@@ -45,6 +74,29 @@ class FieldTrie:
         self._build(leaves)
 
     # --- construction ------------------------------------------------------
+
+    @classmethod
+    def from_array(cls, arr: np.ndarray, limit: int) -> "FieldTrie":
+        """Build from an (n, 32) uint8 leaf array with one batched
+        hash dispatch per level (the stateutil cold-build shape) —
+        the python-loop ``_build`` is O(n) hashlib calls and dominates
+        cold construction at registry scale."""
+        if limit <= 0 or (limit & (limit - 1)) != 0:
+            raise ValueError("limit must be a positive power of two")
+        if arr.shape[0] > limit:
+            raise ValueError("more leaves than limit")
+        self = cls.__new__(cls)
+        self.limit = limit
+        self.depth = limit.bit_length() - 1
+        self.length = arr.shape[0]
+        cur = np.array(arr, dtype=np.uint8, copy=True)
+        if cur.shape[0] == 0:
+            cur = np.zeros((1, 32), dtype=np.uint8)
+        self.levels = [cur]
+        for level in range(self.depth):
+            self.levels.append(
+                _hash_level(self.levels[level], level))
+        return self
 
     def _build(self, leaves: list[bytes]) -> None:
         cur = np.zeros((max(1, self.length), 32), dtype=np.uint8)

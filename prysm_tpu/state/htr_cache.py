@@ -1,0 +1,163 @@
+"""Dirty-field BeaconState HashTreeRoot caching.
+
+Reference analog: the reference's BeaconState caches per-field roots
+and recomputes only dirty ones, with ``fieldtrie.RecomputeTrie``
+backing the big registry fields [U, SURVEY.md §2 "BeaconState",
+"fieldtrie"].  Here the same effect comes from DIFF-based incremental
+tries rather than mutation hooks: the transition code mutates plain
+python lists and containers freely, and at HashTreeRoot time each
+heavy field's current leaf array is compared (vectorized numpy)
+against the trie's stored leaves — only changed leaves re-hash their
+root paths.  Correctness never depends on tracking: the diff IS the
+dirty-set computation, so any mutation pattern (in-place validator
+edits, balance sweeps, whole-list replacement) is caught.
+
+Heavy fields and their trie shapes:
+
+  validators      List[Validator, 2^40]  leaves = per-validator roots
+                  (instance-cached on the Validator, codec root_memo)
+  balances        List[uint64, 2^40]     4-per-chunk packed leaves
+  block_roots / state_roots / randao_mixes   Vector[Bytes32, N]
+  slashings       Vector[uint64, N]      4-per-chunk packed leaves
+
+Everything else re-merkleizes through the codec each call — those
+fields are a few dozen chunks.  One cache instance serves each
+BeaconState class; consecutive roots of an advancing chain diff in
+O(changed), and a replay jumping to an older state is just a bigger
+diff.  Disable with PRYSM_STATE_HTR_CACHE=0 (tests differentially
+compare both paths)."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ..ssz.codec import (
+    ZERO_HASHES, merkleize_chunks, mix_in_length,
+)
+from .fieldtrie import FieldTrie
+
+# list fields: (full ladder depth in chunks, leaves builder)
+_REGISTRY_LIMIT = 2 ** 40
+_LIST_DEPTH = {
+    "validators": 40,         # 2^40 element chunks
+    "balances": 38,           # 2^40 uint64 -> 2^38 chunks
+}
+_VECTOR_FIELDS = ("block_roots", "state_roots", "randao_mixes",
+                  "slashings")
+
+
+def _pack_u64(values) -> np.ndarray:
+    arr = np.asarray(values, dtype="<u8")
+    pad = (-arr.shape[0]) % 4
+    if pad:
+        arr = np.concatenate([arr, np.zeros(pad, dtype="<u8")])
+    if arr.shape[0] == 0:
+        return np.zeros((0, 32), dtype=np.uint8)
+    return arr.view(np.uint8).reshape(-1, 32)
+
+
+def _leaf_array(name: str, typ, value) -> np.ndarray:
+    """(n_chunks, 32) uint8 leaf chunks for a heavy field."""
+    if name == "validators":
+        vt = typ.elem
+        htr = vt.hash_tree_root
+        out = np.empty((len(value), 32), dtype=np.uint8)
+        for i, v in enumerate(value):
+            out[i] = np.frombuffer(htr(v), dtype=np.uint8)
+        return out
+    if name in ("balances", "slashings"):
+        return _pack_u64(value)
+    # Bytes32 vectors
+    if not value:
+        return np.zeros((0, 32), dtype=np.uint8)
+    return np.frombuffer(b"".join(value), dtype=np.uint8).reshape(-1, 32)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class StateHTRCache:
+    """Per-BeaconState-class diff-based root cache."""
+
+    def __init__(self, cls):
+        self.cls = cls
+        self._tries: dict[str, FieldTrie] = {}
+        self._lock = threading.Lock()
+
+    def root(self, state) -> bytes:
+        with self._lock:
+            roots = []
+            for name, typ in self.cls.fields:
+                value = getattr(state, name)
+                if name in _LIST_DEPTH:
+                    roots.append(self._list_root(name, typ, value))
+                elif name in _VECTOR_FIELDS:
+                    roots.append(self._vector_root(name, typ, value))
+                else:
+                    roots.append(typ.hash_tree_root(value))
+            return merkleize_chunks(roots)
+
+    # --- field paths -------------------------------------------------------
+
+    def _sync_trie(self, name: str, leaves: np.ndarray) -> FieldTrie:
+        """Bring the field's trie to the current leaf array: rebuild on
+        shrink/overflow, append growth, then re-hash only the leaves
+        whose bytes changed."""
+        n = leaves.shape[0]
+        trie = self._tries.get(name)
+        if trie is None or n < trie.length or n > trie.limit:
+            trie = FieldTrie.from_array(leaves, _next_pow2(n))
+            self._tries[name] = trie
+            return trie
+        if n > trie.length:
+            for i in range(trie.length, n):
+                trie.append(leaves[i].tobytes())
+        base = trie.levels[0][:n]
+        dirty = np.nonzero((base != leaves).any(axis=1))[0]
+        if dirty.size:
+            trie.update_batch(
+                {int(i): leaves[i].tobytes() for i in dirty})
+        return trie
+
+    def _list_root(self, name: str, typ, value) -> bytes:
+        leaves = _leaf_array(name, typ, value)
+        trie = self._sync_trie(name, leaves)
+        node = trie.vector_root()
+        for level in range(trie.depth, _LIST_DEPTH[name]):
+            node = _hash2(node, ZERO_HASHES[level])
+        return mix_in_length(node, len(value))
+
+    def _vector_root(self, name: str, typ, value) -> bytes:
+        leaves = _leaf_array(name, typ, value)
+        n = leaves.shape[0]
+        if n == 0 or n & (n - 1):
+            # non-pow2 chunk count (odd preset): codec fallback
+            return typ.hash_tree_root(value)
+        trie = self._sync_trie(name, leaves)
+        return trie.vector_root()
+
+
+def _hash2(a: bytes, b: bytes) -> bytes:
+    import hashlib
+
+    return hashlib.sha256(a + b).digest()
+
+
+_CACHES: dict[type, StateHTRCache] = {}
+_ENABLED = os.environ.get("PRYSM_STATE_HTR_CACHE", "1") != "0"
+
+
+def state_hash_tree_root(cls, value) -> bytes:
+    """Entry point wired into the BeaconState class (proto/types.py)."""
+    if not _ENABLED:
+        from ..ssz.codec import Container
+
+        return Container.hash_tree_root.__func__(cls, value)
+    cache = _CACHES.get(cls)
+    if cache is None:
+        cache = _CACHES[cls] = StateHTRCache(cls)
+    return cache.root(value)

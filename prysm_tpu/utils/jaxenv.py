@@ -1,0 +1,105 @@
+"""Shared JAX environment setup: platform forcing + compile-cache dirs.
+
+Single home for the three rules every entry point (tests/conftest.py,
+bench.py, __graft_entry__.py, ad-hoc scripts) must agree on:
+
+1. **Two cache families.** ``.jax_cache`` serves TPU-attached (axon)
+   runs; ``.jax_cache_cpu/<fingerprint>`` serves forced-CPU runs.  They
+   must never mix: a tunnel-attached process can deposit CPU-AOT
+   entries compiled with the REMOTE host's machine features
+   (``+amx-*``, ``+prefer-no-gather`` …), and loading those on this
+   host fails or SIGILLs (``cpu_aot_loader`` feature mismatch — the
+   round-2 multichip timeout).
+2. **Host fingerprinting.** CPU AOT entries embed target machine
+   features, so the CPU cache dir is keyed by a digest of the local
+   CPU identity + jax version.  Foreign entries land in a different
+   subdir and are simply never seen — a cold recompile instead of a
+   fatal load.
+3. **Platform forcing.** This image's axon sitecustomize overrides the
+   ``JAX_PLATFORMS`` env var, so forcing CPU requires
+   ``jax.config.update('jax_platforms', 'cpu')`` before the backend
+   initializes; virtual-device count must go into ``XLA_FLAGS`` even
+   earlier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import platform
+import re
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+TPU_CACHE = os.path.join(REPO_ROOT, ".jax_cache")
+CPU_CACHE_BASE = os.path.join(REPO_ROOT, ".jax_cache_cpu")
+
+
+def host_fingerprint() -> str:
+    """Short digest of the local CPU identity (model + feature flags)
+    and jax version — the compatibility domain of a CPU AOT entry."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            lines = f.read().splitlines()
+        keep = sorted({ln.strip() for ln in lines
+                       if ln.startswith(("flags", "model name"))})
+        blob = "|".join(keep)
+    except OSError:
+        blob = platform.processor()
+    import jax
+
+    blob += f"|{platform.machine()}|jax={jax.__version__}"
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def cpu_cache_dir() -> str:
+    """Fingerprint-keyed CPU cache dir; evicts legacy un-keyed entries
+    sitting directly in the base dir (they may be foreign AOT blobs)."""
+    try:
+        for name in os.listdir(CPU_CACHE_BASE):
+            p = os.path.join(CPU_CACHE_BASE, name)
+            if os.path.isfile(p):
+                os.remove(p)
+    except OSError:
+        pass
+    return os.path.join(CPU_CACHE_BASE, host_fingerprint())
+
+
+def use_cache(path: str, write: bool = True) -> None:
+    """Point BOTH the env var and the config key at one cache dir
+    (this jax build ignores the env var; other code re-applies env to
+    config, so they must agree).  ``write=False`` keeps the cache
+    read-only: jaxlib's native ``executable.serialize()`` can segfault
+    in long-running processes with many prior CPU compiles (observed
+    deterministically in full-suite runs), so the suite reads a warm
+    cache that per-file ``PRYSM_CACHE_WRITE=1`` runs populate."""
+    import jax
+
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      1.0 if write else 1e18)
+
+
+def force_cpu(n_devices: int = 8, fast_compile: bool = False) -> None:
+    """Force the CPU platform with ``n_devices`` virtual devices.
+    Must run before the JAX backend initializes in this process.
+
+    ``fast_compile=True`` adds ``--xla_backend_optimization_level=0``:
+    ~2x faster XLA:CPU compiles at ~3x slower execution — the right
+    trade for the driver's multichip dryrun (compile-dominated, runs
+    one step), the wrong one for the test suite (execution-dominated
+    once the cache is warm).  The flag participates in the compile
+    cache key, so the two modes keep separate entries."""
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    flags = (flags
+             + f" --xla_force_host_platform_device_count={n_devices}")
+    if fast_compile and "--xla_backend_optimization_level" not in flags:
+        flags += " --xla_backend_optimization_level=0"
+    os.environ["XLA_FLAGS"] = flags.strip()
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -212,11 +212,16 @@ def decrypt_keystore(keystore: dict, password: str) -> bytes:
 
 
 def save_keystore(keystore: dict, dirpath: str) -> str:
-    """Write with the upstream naming convention; returns the path."""
+    """Write with the upstream naming convention; returns the path.
+
+    Files are created 0600 (validator key material: the contents are
+    encrypted, but world-readable keystores invite offline password
+    cracking — the reference writes key files owner-only)."""
     name = "keystore-%s.json" % keystore["uuid"]
     os.makedirs(dirpath, exist_ok=True)
     path = os.path.join(dirpath, name)
-    with open(path, "w") as f:
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
         json.dump(keystore, f, indent=2)
     return path
 

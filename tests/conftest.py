@@ -1,56 +1,30 @@
 """Force tests onto a virtual 8-device CPU mesh.
 
 The real TPU (1 chip) is reserved for bench.py; unit tests exercise
-sharding on a virtual CPU mesh per the driver contract.
+sharding on a virtual CPU mesh per the driver contract.  All platform
+forcing and compile-cache policy lives in ``prysm_tpu.utils.jaxenv``
+(shared with ``__graft_entry__.dryrun_multichip`` so the suite and the
+driver dryrun warm the SAME fingerprint-keyed cache).
 
-NOTE: this image's axon sitecustomize pins the TPU platform in a way
-that overrides the JAX_PLATFORMS *env var*, so we must also call
-``jax.config.update('jax_platforms', 'cpu')`` — env alone silently
-leaves tests on the TPU.  XLA_FLAGS must still be set before the CPU
-backend initializes to get 8 virtual devices.
+Cache writes are disabled for full-suite runs (jaxlib's native
+``executable.serialize()`` segfaults non-deterministically in
+long-running processes that have done many prior CPU compiles); reads
+are unaffected.  To (re)populate the cache run ``make warm-cache`` (or
+individual test files with ``PRYSM_CACHE_WRITE=1``).
 """
 
 import os
+import sys
 
-import re as _re
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_flags = os.environ.get("XLA_FLAGS", "")
-_flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
-os.environ["XLA_FLAGS"] = (
-    _flags + " --xla_force_host_platform_device_count=8"
-).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
-# Persistent compilation cache: the limb-arithmetic graphs are big and
-# recompiling them per pytest run would dominate suite time.
-# SEPARATE from the TPU-run cache (.jax_cache): processes attached to
-# the axon tunnel can deposit CPU-AOT entries compiled with the REMOTE
-# host's machine features (prefer-no-scatter etc.), and loading those
-# locally segfaults (cpu_aot_loader feature-mismatch SIGILL).
-# assign unconditionally: a pre-existing env value (e.g. exported for
-# a TPU run) must NOT keep tests on the TPU-run cache
-os.environ["JAX_COMPILATION_CACHE_DIR"] = "/root/repo/.jax_cache_cpu"
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+from prysm_tpu.utils import jaxenv  # noqa: E402
+
+jaxenv.force_cpu(8)
+jaxenv.use_cache(jaxenv.cpu_cache_dir(),
+                 write=os.environ.get("PRYSM_CACHE_WRITE") == "1")
 
 import jax  # noqa: E402  (after env setup, before any test imports)
 
-jax.config.update("jax_platforms", "cpu")
-# this jax build ignores the JAX_COMPILATION_CACHE_DIR env var — the
-# config key must be set explicitly or nothing is ever cached
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ["JAX_COMPILATION_CACHE_DIR"])
-# Cache WRITES are disabled for full-suite runs: jaxlib's native
-# executable.serialize() segfaults non-deterministically in
-# long-running processes that have done many prior CPU compiles
-# (observed twice, deterministically, at the 16th test of a full run —
-# jax/_src/compilation_cache.py put_executable_and_time; the same
-# entry writes fine from a fresh process).  Reads are unaffected, so
-# the suite still loads a warm cache.  To (re)populate the cache, run
-# individual test files with PRYSM_CACHE_WRITE=1:
-#   for f in tests/test_*.py; do PRYSM_CACHE_WRITE=1 pytest "$f"; done
-if os.environ.get("PRYSM_CACHE_WRITE") == "1":
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-else:
-    jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                      1e18)
 assert jax.devices()[0].platform == "cpu"
 assert len(jax.devices()) == 8, jax.devices()

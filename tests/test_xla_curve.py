@@ -109,3 +109,47 @@ class TestG2:
         bits = C.scalar_bits_from_ints([R], R.bit_length() + 1)
         got = C.unpack_g2_points(C.g2_scalar_mul(C.g2_generator(1), bits))
         assert got == [None]
+
+
+class TestWindowedScalarMul:
+    """4-bit windowed RLC fast path vs pure (curve.scalar_mul_windowed)."""
+
+    def test_g1_windowed_64bit(self, rng):
+        import jax
+
+        pts = rand_g1(rng, 4)
+        ks = [rng.randrange(1, 1 << 64) | 1 for _ in range(3)] + [0]
+        bits = C.scalar_bits_from_ints(ks, 64)
+        fn = jax.jit(lambda p, b: C.scalar_mul_windowed(C.FP_OPS, p, b))
+        got = C.unpack_g1_points(fn(C.pack_g1_points(pts), bits))
+        assert got == [pc.multiply(p, k) for p, k in zip(pts, ks)]
+
+    def test_g2_windowed_64bit(self, rng):
+        import jax
+
+        pts = rand_g2(rng, 2)
+        ks = [rng.randrange(1, 1 << 64) | 1 for _ in range(2)]
+        bits = C.scalar_bits_from_ints(ks, 64)
+        fn = jax.jit(lambda p, b: C.scalar_mul_windowed(C.FQ2_OPS, p, b))
+        got = C.unpack_g2_points(fn(C.pack_g2_points(pts), bits))
+        assert got == [pc.multiply(p, k) for p, k in zip(pts, ks)]
+
+    def test_g1_windowed_8bit_and_infinity_base(self, rng):
+        """The dryrun shape (8-bit scalars) + infinity base point."""
+        import jax
+
+        p = rand_g1(rng, 1)[0]
+        pts = [p, None]
+        ks = [171, 9]
+        bits = C.scalar_bits_from_ints(ks, 8)
+        fn = jax.jit(lambda q, b: C.scalar_mul_windowed(C.FP_OPS, q, b))
+        got = C.unpack_g1_points(fn(C.pack_g1_points(pts), bits))
+        assert got == [pc.multiply(p, 171), None]
+
+    def test_unequal_add_matches_general(self, rng):
+        p, q = rand_g1(rng, 2)
+        dev_p = C.pack_g1_points([p, p, None])
+        dev_q = C.pack_g1_points([q, None, q])
+        got = C.unpack_g1_points(
+            C.point_add_unequal(C.FP_OPS, dev_p, dev_q))
+        assert got == [pc.add(p, q), p, q]
