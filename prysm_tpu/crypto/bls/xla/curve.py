@@ -207,14 +207,23 @@ def scalar_mul_windowed(ops: FieldOps, pt, scalar_bits):
     assert nbits % _WINDOW == 0, "bit count must be a window multiple"
     nwin = nbits // _WINDOW
 
-    # table[d] = [d]P: even entries by doubling, odd by unequal add
-    tbl = [point_inf_like(ops, pt), pt]
-    for d in range(2, 1 << _WINDOW):
-        if d % 2 == 0:
-            tbl.append(point_double(ops, tbl[d // 2]))
-        else:
-            tbl.append(point_add_unequal(ops, tbl[d - 1], pt))
-    table = tuple(jnp.stack([t[i] for t in tbl], axis=0)
+    # table[d] = [d]P, built LEVEL-wise so the whole 16-entry table
+    # costs 6 batched point ops (3 levels x (1 dbl + 1 add)), not 14
+    # sequential ones: level k maps [T_d] -> [T_2d, T_2d+1] via one
+    # batched double + one batched unequal add, interleaved.
+    inf = point_inf_like(ops, pt)
+    level = tuple(t[None] for t in pt)               # [T_1]
+    tiers = [tuple(t[None] for t in inf), level]     # [T_0], [T_1]
+    for _ in range(_WINDOW - 1):
+        evens = point_double(ops, level)
+        base = tuple(jnp.broadcast_to(t[None], e.shape)
+                     for t, e in zip(pt, evens))
+        odds = point_add_unequal(ops, evens, base)
+        level = tuple(
+            jnp.stack([e, o], axis=1).reshape((-1,) + e.shape[1:])
+            for e, o in zip(evens, odds))
+        tiers.append(level)
+    table = tuple(jnp.concatenate([t[i] for t in tiers], axis=0)
                   for i in range(3))                 # (16, ..., limbs)
 
     # bit planes -> window digits (nwin, ...)
@@ -372,7 +381,13 @@ def _flatten(nested):
 # --- batched reductions ----------------------------------------------------
 
 
-_SUM_CHUNK = 8
+# Halving-tree threshold: on TPU, slot-verify latency is bound by
+# SEQUENTIAL depth, not batch width, so mainnet-size committees
+# (200 validators) should reduce by an 8-level unrolled halving tree
+# (depth log2 n) rather than a 25-step chunked scan.  The scan path
+# remains for very large batches where the unrolled tree's compile
+# cost would dominate.
+_SUM_CHUNK = 128
 
 
 def _point_sum_halving(ops: FieldOps, pt):

@@ -223,10 +223,45 @@ _SEL_LOW = _build_select_matrix(NLIMBS)
 def _mul_columns(a, b, low_only: bool = False):
     """Schoolbook product as redundant columns: 48 columns for the full
     768-bit product, or 24 columns of the low half (mod 2**384).
-    Column entries are sums of <= 48 half-products: < 2**21.6."""
+    Column entries are sums of <= 48 half-products: < 2**21.6.
+
+    TWO formulations, selected by backend at trace time:
+
+    * CPU: one uint32 dot_general against a static 0/1 selection
+      matrix — an order-of-magnitude smaller graph (XLA:CPU compile of
+      the pad+add form cost ~38 s of LLVM per fp_mul; the 1-core test
+      host compiles hundreds of these).  Verified bit-exact on XLA:CPU.
+    * TPU: the unrolled pad+add anti-diagonal sums.  XLA:TPU's
+      emulated uint32 dot SILENTLY LOSES BITS at larger operand ranks/
+      batches (found 2026-07-31: fp_mul exact at rank 2 any batch, but
+      the rank-5 stacked tower shapes at batch >= ~16 corrupt most
+      coefficients — a precision bug in the integer-dot emulation, not
+      in this module's math, confirmed against exact integer
+      references).  The pad+add form is exact everywhere.
+    """
     prods = a[..., :, None] * b[..., None, :]          # (..., 24, 24) u32
     lo = prods & MASK32
     hi = prods >> RADIX_BITS
+    if jax.default_backend() != "cpu":
+        width = NLIMBS if low_only else 2 * NLIMBS
+        cols = jnp.zeros(prods.shape[:-2] + (width,), dtype=jnp.uint32)
+        for i in range(NLIMBS):
+            if low_only:
+                keep_lo = min(NLIMBS, width - i)
+                pads = [(0, 0)] * (lo.ndim - 2) + [(i, width - i - keep_lo)]
+                cols = cols + jnp.pad(lo[..., i, :keep_lo], pads)
+                if i + 1 < NLIMBS:
+                    keep_hi = min(NLIMBS, width - i - 1)
+                    pads = [(0, 0)] * (hi.ndim - 2) \
+                        + [(i + 1, width - i - 1 - keep_hi)]
+                    cols = cols + jnp.pad(hi[..., i, :keep_hi], pads)
+            else:
+                pads = [(0, 0)] * (lo.ndim - 2) + [(i, width - i - NLIMBS)]
+                cols = cols + jnp.pad(lo[..., i, :], pads)
+                pads = [(0, 0)] * (hi.ndim - 2) \
+                    + [(i + 1, width - i - 1 - NLIMBS)]
+                cols = cols + jnp.pad(hi[..., i, :], pads)
+        return cols
     flat = jnp.concatenate(
         [lo.reshape(lo.shape[:-2] + (NLIMBS * NLIMBS,)),
          hi.reshape(hi.shape[:-2] + (NLIMBS * NLIMBS,))], axis=-1)
@@ -281,8 +316,19 @@ def get_mul_backend() -> str:
 
 @jax.jit
 def fp_mul(a, b):
-    """Montgomery product mont(a) * mont(b) -> mont(a*b)."""
-    if _MUL_BACKEND == "pallas":
+    """Montgomery product mont(a) * mont(b) -> mont(a*b).
+
+    On TPU this ALWAYS routes through the Mosaic kernel, regardless of
+    the backend flag: XLA:TPU miscompiles large fused uint32 programs
+    (verified 2026-07-31 — every limb op is bit-exact standalone at
+    any rank/batch, but composed towers silently corrupt most
+    coefficients once the fused program passes a size threshold;
+    slot-verify returned False for valid slots).  The kernel is
+    bit-exact AND each launch bounds XLA's fusion regions to the
+    small shapes that are proven exact.  The plain XLA formulation
+    remains the CPU path (exact there, and interpret-mode kernels
+    would be unusably slow)."""
+    if _MUL_BACKEND == "pallas" or jax.default_backend() == "tpu":
         from .pallas_mont import mont_mul_pallas
 
         return mont_mul_pallas(a, b)
@@ -360,8 +406,43 @@ def fp_pow_fixed(a, e: int):
 
 @jax.jit
 def fp_inv(a):
-    """Fermat inversion a**(P-2); inverse of 0 is 0 (callers guard)."""
-    return fp_pow_fixed(a, P - 2)
+    """Fermat inversion a**(P-2) via 4-bit windowed square-and-
+    multiply: 95 window steps (4 squarings + a one-hot table multiply)
+    instead of a 380-step bit scan.  Slot-verify latency on TPU is
+    bound by SEQUENTIAL step count, not batch width (an 8x8 slot costs
+    ~the same as 64x200), and the inversion scan was the single
+    deepest chain in every pairing-check graph.  Inverse of 0 is 0
+    (the zero row propagates through the table).
+
+    The 16-entry power table builds level-wise (3 stacked sqr+mul
+    rounds); window digits of P-2 are static."""
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+    level = a[None]                              # [a^1]
+    tiers = [one[None], level]
+    for _ in range(3):
+        evens = fp_sqr(level)                    # a^(2d)
+        odds = fp_mul(evens, a[None])            # a^(2d+1)
+        level = jnp.stack([evens, odds], axis=1).reshape(
+            (-1,) + evens.shape[1:])
+        tiers.append(level)
+    table = jnp.concatenate(tiers, axis=0)       # (16, ..., 24)
+
+    e = P - 2
+    ndig = (e.bit_length() + 3) // 4
+    digits = [(e >> (4 * i)) & 15 for i in reversed(range(ndig))]
+    acc = table[digits[0]]
+    oh_shape = (16,) + (1,) * (table.ndim - 1)
+    dvals = jnp.arange(16, dtype=jnp.uint32).reshape(oh_shape)
+
+    def body(acc, d):
+        for _ in range(4):
+            acc = fp_sqr(acc)
+        sel = jnp.sum(table * (d == dvals).astype(jnp.uint32), axis=0)
+        return fp_mul(acc, sel), None
+
+    acc, _ = lax.scan(body, acc,
+                      jnp.asarray(np.array(digits[1:], np.uint32)))
+    return acc
 
 
 # --- host <-> device conversion -------------------------------------------

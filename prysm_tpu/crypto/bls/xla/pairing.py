@@ -154,7 +154,9 @@ def miller_loop(p_aff, q_aff):
     return T.fq12_conj(f)
 
 
-_PROD_CHUNK = 8
+# log-depth halving up to 256 elements (sequential depth beats batch
+# width on TPU — see curve._SUM_CHUNK); chunked scan beyond
+_PROD_CHUNK = 128
 
 
 def _fq12_prod_halving(f):

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from . import limbs as L
@@ -26,6 +27,14 @@ from . import limbs as L
 _RADIX = np.uint32(1 << L.RADIX_BITS)
 _MASK = np.uint32((1 << L.RADIX_BITS) - 1)
 _SHIFT = np.uint32(L.RADIX_BITS)
+
+
+def row(x, i: int):
+    """x[i] via a STATIC slice + squeeze.  ``x[i]`` integer indexing
+    lowers to the dynamic_slice primitive (even for constant i),
+    which Mosaic does not implement — every in-kernel row access must
+    come through here."""
+    return jnp.squeeze(jax.lax.slice_in_dim(x, i, i + 1, axis=0), 0)
 
 
 def shift_up(x, k: int = 1, fill: int = 0):
@@ -50,7 +59,7 @@ def carry_resolve(x, n: int):
         shift *= 2
     carry_in = shift_up(g)                   # c[i] = G[i-1], c[0] = 0
     out = (x + carry_in) & _MASK
-    return out, g[-1]
+    return out, row(g, g.shape[0] - 1)
 
 
 def carry_norm(cols, n_out: int):
@@ -71,7 +80,7 @@ def mul_columns(a, b, low_only: bool = False):
     width = n if low_only else 2 * n
     cols = jnp.zeros((width,) + a.shape[1:], dtype=jnp.uint32)
     for i in range(n):
-        p = a[i][None, :] * b                   # (24, B) uint32, exact
+        p = row(a, i)[None, :] * b              # (24, B) uint32, exact
         lo = p & _MASK
         hi = p >> _SHIFT
         if low_only:
@@ -93,7 +102,7 @@ def sub_borrow(a, b):
          jnp.zeros((L.NLIMBS - 1,) + s.shape[1:], jnp.uint32)], axis=0)
     s = s + one
     hi = s >> _SHIFT
-    top_carry = hi[-1]
+    top_carry = row(hi, hi.shape[0] - 1)
     s = (s & _MASK) + shift_up(hi)
     diff, carry_out = carry_resolve(s, L.NLIMBS)
     return diff, jnp.uint32(1) - (top_carry | carry_out)

@@ -92,83 +92,91 @@ _FQ12_TERMS = _build_fq12_terms()
 # --- kernel ----------------------------------------------------------------
 
 
-def _fq12_mul_kernel(p_ref, np_ref, a_ref, b_ref, o_ref):
-    a = a_ref[:]                                # (12, 24, B)
-    b = b_ref[:]
-    width = a.shape[2]
-    p = jnp.broadcast_to(p_ref[:][:, None], (L.NLIMBS, width))
-    npr = jnp.broadcast_to(np_ref[:][:, None], (L.NLIMBS, width))
+def _coeff(x, i: int):
+    """Fp coefficient i of a (288, B) flattened Fq12 tile — a STATIC
+    24-row slice (2D blocks throughout: rank-3 blocks exercised a
+    Mosaic lowering path that miscompiled most coefficients)."""
+    import jax as _jax
 
-    avs = [a[i] for i in range(12)]
-    bvs = [b[i] for i in range(12)]
+    return _jax.lax.slice_in_dim(x, 24 * i, 24 * (i + 1), axis=0)
 
-    variant_cache: dict = {}
 
-    def b_variant(slot: int, var: int):
-        key = (slot, var)
-        got = variant_cache.get(key)
-        if got is not None:
-            return got
-        c0, c1 = bvs[2 * slot], bvs[2 * slot + 1]
-        if var == _V_C0:
-            v = c0
-        elif var == _V_C1:
-            v = c1
-        elif var == _V_NC0:
-            v = F.fp_neg(c0, p)
-        elif var == _V_NC1:
-            v = F.fp_neg(c1, p)
-        elif var == _V_D:
-            v = F.fp_sub(c0, c1, p)
-        elif var == _V_S:
-            v = F.fp_add(c0, c1, p)
-        elif var == _V_ND:
-            v = F.fp_sub(c1, c0, p)
-        else:
-            v = F.fp_neg(F.fp_add(c0, c1, p), p)
-        variant_cache[key] = v
-        return v
+def _make_coeff_kernel(o: int):
+    """Kernel computing output Fp coefficient ``o`` of the Fq12
+    product — one coefficient per pallas_call, validated bit-exact on
+    real TPU hardware against integer references.
 
-    prod_cache: dict = {}
+    History note: multi-coefficient variants of this kernel appeared
+    to miscompile during bring-up, but the mismatches were later
+    traced to the XLA:TPU fusion bug corrupting the KARATSUBA
+    REFERENCE they were compared against (see limbs.fp_mul).  The
+    single-coefficient split is kept because it is the configuration
+    proven exact against integer ground truth; twelve small launches
+    still replace ~600 HLO ops of the XLA tier per Fq12 multiply."""
 
-    def prod(i: int, slot: int, var: int):
-        key = (i, slot, var)
-        got = prod_cache.get(key)
-        if got is None:
-            got = F.mul_columns(avs[i], b_variant(slot, var))
-            prod_cache[key] = got
-        return got
+    def kernel(p_ref, np_ref, a_ref, b_ref, o_ref):
+        a = a_ref[:]                            # (288, B)
+        b = b_ref[:]
+        width = a.shape[1]
+        p = jnp.broadcast_to(p_ref[:][:, None], (L.NLIMBS, width))
+        npr = jnp.broadcast_to(np_ref[:][:, None], (L.NLIMBS, width))
 
-    outs = []
-    for o in range(12):
+        def b_variant(slot: int, var: int):
+            c0 = _coeff(b, 2 * slot)
+            c1 = _coeff(b, 2 * slot + 1)
+            if var == _V_C0:
+                return c0
+            if var == _V_C1:
+                return c1
+            if var == _V_NC0:
+                return F.fp_neg(c0, p)
+            if var == _V_NC1:
+                return F.fp_neg(c1, p)
+            if var == _V_D:
+                return F.fp_sub(c0, c1, p)
+            if var == _V_S:
+                return F.fp_add(c0, c1, p)
+            if var == _V_ND:
+                return F.fp_sub(c1, c0, p)
+            return F.fp_neg(F.fp_add(c0, c1, p), p)
+
         cols = None
         for (i, slot, var) in _FQ12_TERMS[o]:
-            t = prod(i, slot, var)
+            t = F.mul_columns(_coeff(a, i), b_variant(slot, var))
             cols = t if cols is None else cols + t
         red = F.mont_reduce(cols, p, npr)
-        outs.append(F.csub_p(red, p))           # lazy sums bound < 3P
-    o_ref[:] = jnp.stack(outs)
+        o_ref[:] = F.csub_p(red, p)             # lazy sums bound < 3P
+
+    # distinct names: kernels with identical signatures can otherwise
+    # be conflated downstream (all twelve launched as one of them)
+    kernel.__name__ = f"fq12_coeff_{o}_kernel"
+    return kernel
 
 
 @partial(jax.jit, static_argnums=(2,))
 def _fq12_mul_flat(a_t, b_t, interpret: bool):
-    """(12, 24, n) x (12, 24, n) -> (12, 24, n); n % LANES == 0."""
-    n = a_t.shape[2]
+    """(288, n) x (288, n) -> (288, n); n % LANES == 0."""
+    n = a_t.shape[1]
     block = _BLOCK if n % _BLOCK == 0 else LANES
-    return pl.pallas_call(
-        _fq12_mul_kernel,
-        out_shape=jax.ShapeDtypeStruct((12, L.NLIMBS, n), jnp.uint32),
-        grid=(n // block,),
-        in_specs=[
-            pl.BlockSpec((L.NLIMBS,), lambda i: (0,)),
-            pl.BlockSpec((L.NLIMBS,), lambda i: (0,)),
-            pl.BlockSpec((12, L.NLIMBS, block), lambda i: (0, 0, i)),
-            pl.BlockSpec((12, L.NLIMBS, block), lambda i: (0, 0, i)),
-        ],
-        out_specs=pl.BlockSpec((12, L.NLIMBS, block),
-                               lambda i: (0, 0, i)),
-        interpret=interpret,
-    )(jnp.asarray(L.P_LIMBS), jnp.asarray(L.NPRIME_LIMBS), a_t, b_t)
+    rows = 12 * L.NLIMBS
+    p_l = jnp.asarray(L.P_LIMBS)
+    np_l = jnp.asarray(L.NPRIME_LIMBS)
+    outs = []
+    for o in range(12):
+        outs.append(pl.pallas_call(
+            _make_coeff_kernel(o),
+            out_shape=jax.ShapeDtypeStruct((L.NLIMBS, n), jnp.uint32),
+            grid=(n // block,),
+            in_specs=[
+                pl.BlockSpec((L.NLIMBS,), lambda i: (0,)),
+                pl.BlockSpec((L.NLIMBS,), lambda i: (0,)),
+                pl.BlockSpec((rows, block), lambda i: (0, i)),
+                pl.BlockSpec((rows, block), lambda i: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((L.NLIMBS, block), lambda i: (0, i)),
+            interpret=interpret,
+        )(p_l, np_l, a_t, b_t))
+    return jnp.concatenate(outs, axis=0)
 
 
 def fq12_mul_pallas(a, b, interpret: bool | None = None):
@@ -180,15 +188,15 @@ def fq12_mul_pallas(a, b, interpret: bool | None = None):
     b = jnp.broadcast_to(b, shape)
     batch = int(np.prod(shape[:-4], dtype=np.int64)) \
         if len(shape) > 4 else 1
-    fa = jnp.moveaxis(a.reshape(batch, 12, L.NLIMBS), 0, -1)
-    fb = jnp.moveaxis(b.reshape(batch, 12, L.NLIMBS), 0, -1)
+    fa = a.reshape(batch, 12 * L.NLIMBS).T
+    fb = b.reshape(batch, 12 * L.NLIMBS).T
     n_pad = -(-batch // LANES) * LANES
     if n_pad != batch:
-        pad = ((0, 0), (0, 0), (0, n_pad - batch))
+        pad = ((0, 0), (0, n_pad - batch))
         fa = jnp.pad(fa, pad)
         fb = jnp.pad(fb, pad)
     out = _fq12_mul_flat(fa, fb, bool(interpret))
-    return jnp.moveaxis(out, -1, 0)[:batch].reshape(shape)
+    return out.T[:batch].reshape(shape)
 
 
 def fq12_sqr_pallas(a, interpret: bool | None = None):

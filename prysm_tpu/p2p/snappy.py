@@ -1,0 +1,145 @@
+"""Pure-python Snappy BLOCK format codec.
+
+Reference analog: the reference's gossip payloads are snappy
+block-compressed on the wire [U, SURVEY.md §2 "p2p"].  No snappy
+library ships in this image, so this module implements the format
+directly:
+
+* ``compress`` emits a spec-valid stream using literal elements only
+  (the format permits a stream with no copy elements; compression
+  ratio 1.0 minus framing).  Interop matters here, not ratio — any
+  conformant decoder can read our frames.
+* ``decompress`` implements the FULL element set (literals and all
+  three copy forms, including overlapping copies), so frames produced
+  by real snappy encoders decode correctly.
+
+Format (github.com/google/snappy format_description.txt semantics,
+implemented from the spec, not from snappy sources):
+
+  preamble: uncompressed length, little-endian base-128 varint
+  elements: tag byte, low 2 bits select the element type
+    00 literal: length-1 in tag>>2 if < 60, else 60..63 selects 1..4
+       little-endian extra length bytes
+    01 copy, 1-byte offset: length-4 in bits 2..4, offset =
+       (tag>>5) << 8 | next byte   (4 <= len <= 11, offset < 2048)
+    10 copy, 2-byte little-endian offset: length-1 in tag>>2
+    11 copy, 4-byte little-endian offset: length-1 in tag>>2
+"""
+
+from __future__ import annotations
+
+
+class SnappyError(ValueError):
+    pass
+
+
+def _varint_encode(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _varint_decode(data: bytes, pos: int) -> tuple[int, int]:
+    shift = 0
+    value = 0
+    while True:
+        if pos >= len(data):
+            raise SnappyError("truncated varint")
+        b = data[pos]
+        pos += 1
+        value |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return value, pos
+        shift += 7
+        if shift > 35:
+            raise SnappyError("varint too long")
+
+
+_MAX_LITERAL = (1 << 24)    # emit 3-byte length form at most
+
+
+def compress(data: bytes) -> bytes:
+    """Spec-valid snappy block stream (all-literal elements)."""
+    out = bytearray(_varint_encode(len(data)))
+    pos = 0
+    n = len(data)
+    while pos < n:
+        chunk = data[pos:pos + _MAX_LITERAL]
+        ln = len(chunk)
+        if ln <= 60:
+            out.append(((ln - 1) << 2))
+        elif ln < (1 << 8):
+            out.append(60 << 2)
+            out.append(ln - 1)
+        elif ln < (1 << 16):
+            out.append(61 << 2)
+            out += (ln - 1).to_bytes(2, "little")
+        else:
+            out.append(62 << 2)
+            out += (ln - 1).to_bytes(3, "little")
+        out += chunk
+        pos += ln
+    return bytes(out)
+
+
+def decompress(data: bytes, max_out: int | None = None) -> bytes:
+    """Full-format decoder (literals + all copy forms)."""
+    want, pos = _varint_decode(data, 0)
+    if max_out is not None and want > max_out:
+        raise SnappyError(f"declared length {want} > cap {max_out}")
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:                       # literal
+            ln = tag >> 2
+            if ln >= 60:
+                nbytes = ln - 59
+                if pos + nbytes > n:
+                    raise SnappyError("truncated literal length")
+                ln = int.from_bytes(data[pos:pos + nbytes], "little")
+                pos += nbytes
+            ln += 1
+            if pos + ln > n:
+                raise SnappyError("truncated literal")
+            out += data[pos:pos + ln]
+            pos += ln
+        else:
+            if kind == 1:                   # 1-byte offset copy
+                if pos >= n:
+                    raise SnappyError("truncated copy-1")
+                length = ((tag >> 2) & 0x7) + 4
+                offset = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif kind == 2:                 # 2-byte offset copy
+                if pos + 2 > n:
+                    raise SnappyError("truncated copy-2")
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos:pos + 2], "little")
+                pos += 2
+            else:                           # 4-byte offset copy
+                if pos + 4 > n:
+                    raise SnappyError("truncated copy-4")
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos:pos + 4], "little")
+                pos += 4
+            if offset == 0 or offset > len(out):
+                raise SnappyError("copy offset out of range")
+            # overlapping copies are defined byte-by-byte
+            start = len(out) - offset
+            for i in range(length):
+                out.append(out[start + i])
+        if len(out) > want:
+            raise SnappyError("output exceeds declared length")
+    if len(out) != want:
+        raise SnappyError(
+            f"output length {len(out)} != declared {want}")
+    return bytes(out)

@@ -402,6 +402,15 @@ def _bytes_to_bits(data: bytes, nbits: int) -> list[bool]:
 # --- containers ------------------------------------------------------------
 
 
+def _invalidating_setattr(self, name, value):
+    """__setattr__ for root_memo containers: any field write drops the
+    instance's cached hash tree root."""
+    d = self.__dict__
+    d[name] = value
+    if "_iroot" in d and name != "_iroot":
+        del d["_iroot"]
+
+
 class Container(SSZType):
     """Base for consensus containers.  Subclasses declare
     ``fields = [("name", ssz_type), ...]``; instances carry the values
@@ -491,14 +500,15 @@ class Container(SSZType):
     # stateutil the same way).  Instance caching beats the previous
     # value-tuple memo dict: no key construction per lookup, and the
     # dirty-field state cache can read 500k validator leaves at
-    # attribute-access speed.
+    # attribute-access speed.  The invalidating __setattr__ installs
+    # ONLY on root_memo classes (__init_subclass__) — non-memo
+    # containers keep the C-level attribute fast path.
     root_memo = False
 
-    def __setattr__(self, name, value):
-        d = self.__dict__
-        d[name] = value
-        if "_iroot" in d and name != "_iroot":
-            del d["_iroot"]
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if cls.__dict__.get("root_memo", False):
+            cls.__setattr__ = _invalidating_setattr
 
     @classmethod
     def hash_tree_root(cls, value) -> bytes:

@@ -52,8 +52,10 @@ def _hash_level(lv: np.ndarray, level: int) -> np.ndarray:
     words = np.frombuffer(lv.tobytes(), dtype=">u4").astype(
         np.uint32).reshape(n_par, 16)
     out = np.asarray(merkle_jax.hash_pairs(words))
+    # .copy(): frombuffer views are READ-ONLY, and these arrays become
+    # trie levels that later point-updates write into
     return np.frombuffer(out.astype(">u4").tobytes(),
-                         dtype=np.uint8).reshape(n_par, 32)
+                         dtype=np.uint8).reshape(n_par, 32).copy()
 
 
 class FieldTrie:

@@ -42,6 +42,21 @@ def _pre_state(types, slot: int):
 
 
 def build_vectors() -> dict:
+    from ..config import beacon_config
+
+    prev_cfg = beacon_config()
+    try:
+        return _build_vectors_minimal()
+    finally:
+        # restore whatever preset the caller had active (tests invoke
+        # this mid-suite)
+        if prev_cfg.preset_name == "mainnet":
+            use_mainnet_config()
+        else:
+            use_minimal_config()
+
+
+def _build_vectors_minimal() -> dict:
     use_minimal_config()
     types = build_types(MINIMAL_CONFIG)
     out = {"config": "minimal", "n_validators": N_VALIDATORS,
@@ -203,7 +218,6 @@ def build_vectors() -> dict:
         "note": "process_slots to the start of epoch 2",
     })
 
-    use_mainnet_config()
     return out
 
 

@@ -1,0 +1,143 @@
+"""Per-stage slot-verify breakdown on the real TPU (VERDICT r2 #2).
+
+Times each stage of ``slot_verify_device`` as its own jitted dispatch
+with the honest methodology (rotated inputs + forced small readback),
+so optimization wins are attributable:
+
+  aggregate   per-committee pubkey tree-sum        (point_sum_tree)
+  scalar_mul  windowed RLC [r]apk + [r]sig         (scalar_mul_windowed)
+  affine      shared-inversion affine conversions  (_batch_affine)
+  miller      65-pairing Miller loop               (miller_loop)
+  final_exp   check final exponentiation           (final_exponentiation_check)
+  full_slot   the whole fused dispatch             (slot_verify_device)
+
+Stage outputs feed the next stage's inputs (precomputed once, then
+rotated across 2 variants).  Writes JSON to stdout and
+``BREAKDOWN.json``.  Run attached to the TPU (no JAX_PLATFORMS=cpu);
+uses the persistent .jax_cache.
+
+Usage: python -m prysm_tpu.tools.perf_breakdown [C] [K]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from ..utils import jaxenv
+
+
+def _sync(r):
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(r):
+        np.asarray(leaf[..., :1] if hasattr(leaf, "ndim") and leaf.ndim
+                   else leaf)
+
+
+def _time(fn, variants, iters=4, warmup=2):
+    times = []
+    for i in range(warmup):
+        _sync(fn(*variants[i % len(variants)]))
+    for i in range(iters):
+        a = variants[i % len(variants)]
+        t0 = time.perf_counter()
+        _sync(fn(*a))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def main() -> None:
+    jaxenv.use_cache(jaxenv.TPU_CACHE)
+    C = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    K = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..crypto.bls import bls
+    from ..crypto.bls.xla import tower as T
+    from ..crypto.bls.xla.curve import (
+        FP_OPS, FQ2_OPS, point_sum_tree, scalar_mul_windowed,
+    )
+    from ..crypto.bls.xla.pairing import (
+        final_exponentiation_check, fq12_prod_tree, miller_loop,
+    )
+    from ..crypto.bls.xla.verify import (
+        _batch_affine, _neg_g1_affine, random_rlc_bits,
+        slot_verify_device,
+    )
+
+    batch = bls.build_synthetic_slot_batch(C, K)
+    pk, sig, h = batch["pk_jac"], batch["sig_jac"], batch["h_jac"]
+    rb = [batch["r_bits"],
+          random_rlc_bits(C, np.random.default_rng(4242))]
+
+    results: dict[str, float] = {}
+
+    # 1. aggregate
+    agg = jax.jit(lambda p: point_sum_tree(
+        FP_OPS, tuple(jnp.moveaxis(t, 1, 0) for t in p)))
+    pk2 = tuple(jnp.roll(t, 1, axis=0) for t in pk)
+    results["aggregate_ms"] = _time(agg, [(pk,), (pk2,)]) * 1e3
+    apk = jax.block_until_ready(agg(pk))
+
+    # 2. windowed scalar muls (both groups, one dispatch)
+    smul = jax.jit(lambda a, s, r: (
+        scalar_mul_windowed(FP_OPS, a, r),
+        scalar_mul_windowed(FQ2_OPS, s, r)))
+    results["scalar_mul_ms"] = _time(
+        smul, [(apk, sig, rb[0]), (apk, sig, rb[1])]) * 1e3
+    r_apk, r_sig = jax.block_until_ready(smul(apk, sig, rb[0]))
+
+    # 3. affine (incl. the [r]sig tree-sum, matching the slot graph)
+    def affine(ra, rs, hh):
+        s = point_sum_tree(FQ2_OPS, rs)
+        g2 = tuple(jnp.concatenate([t_s[None], t_h], axis=0)
+                   for t_s, t_h in zip(s, hh))
+        return _batch_affine(ra, g2)
+
+    aff = jax.jit(affine)
+    ra2 = tuple(jnp.roll(t, 1, axis=0) for t in r_apk)
+    results["affine_ms"] = _time(
+        aff, [(r_apk, r_sig, h), (ra2, r_sig, h)]) * 1e3
+    (ax, ay, _), (qx, qy, _) = jax.block_until_ready(
+        aff(r_apk, r_sig, h))
+
+    # 4. miller loop (65 pairings: -g1/S + C committees)
+    ng_x, ng_y = _neg_g1_affine()
+    px = jnp.concatenate([ng_x[None], ax], axis=0)
+    py = jnp.concatenate([ng_y[None], ay], axis=0)
+    mil = jax.jit(miller_loop)
+    px2 = jnp.roll(px, 1, axis=0)
+    results["miller_ms"] = _time(
+        mil, [((px, py), (qx, qy)), ((px2, py), (qx, qy))]) * 1e3
+    f = jax.block_until_ready(mil((px, py), (qx, qy)))
+
+    # 5. final exponentiation (prod tree + check exp)
+    fexp = jax.jit(lambda x: final_exponentiation_check(
+        fq12_prod_tree(x)))
+    f2 = jnp.roll(f, 1, axis=0)
+    results["final_exp_ms"] = _time(fexp, [(f,), (f2,)]) * 1e3
+
+    # 6. the whole fused dispatch
+    results["full_slot_ms"] = _time(
+        slot_verify_device,
+        [(pk, sig, h, rb[0]), (pk, sig, h, rb[1])]) * 1e3
+
+    results["shape"] = f"{C}x{K}"
+    results["backend"] = jax.default_backend()
+    out = json.dumps(results)
+    print(out, flush=True)
+    path = os.path.join(jaxenv.REPO_ROOT, "BREAKDOWN.json")
+    with open(path, "w") as fh:
+        fh.write(out + "\n")
+
+
+if __name__ == "__main__":
+    main()

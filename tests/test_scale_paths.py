@@ -17,7 +17,8 @@ from prysm_tpu.core.helpers import (
 from prysm_tpu.core.transition import (
     process_slots, pubkey_index_map,
 )
-from prysm_tpu.proto import FAR_FUTURE_EPOCH, Validator, build_types
+from prysm_tpu.core.helpers import FAR_FUTURE_EPOCH
+from prysm_tpu.proto import Validator, build_types
 from prysm_tpu.testing import util as testutil
 
 
