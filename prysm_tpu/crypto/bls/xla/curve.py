@@ -28,6 +28,7 @@ from ..params import (
     G1_X, G1_Y, G2_X_C0, G2_X_C1, G2_Y_C0, G2_Y_C1, P, R,
 )
 from ..pure import fields as pf
+from . import lazy as Zl
 from . import limbs as L
 from . import tower as T
 
@@ -58,58 +59,128 @@ FQ2_OPS = FieldOps(
 )
 
 
+class LazyOps(NamedTuple):
+    """Redundant-form (lazy.LZ) field ops for formula INTERNALS — see
+    lazy.py.  Formulas wrap canonical coords on entry, run the whole
+    add/sub chain as single tensor ops, and canonicalize once at the
+    boundary (which also restores exact-zero infinity flags)."""
+    mul: object
+    mul_many: object    # [(a, b), ...] -> products via ONE stacked core
+    is_zero: object     # modular: value == 0 (mod P)
+    ndims: int
+
+
+def _mul_many(mul, ndims: int, pairs):
+    """Run the independent multiplies of one formula STAGE as a single
+    stacked Montgomery core call (the core cost dominates the point
+    formulas after the lazy rewrite, so core-call count is the graph
+    size lever)."""
+    ax = -(ndims + 1)
+    la = Zl.stack([a for a, _ in pairs], axis=ax)
+    lb = Zl.stack([b for _, b in pairs], axis=ax)
+    t = mul(la, lb)
+    idx = (Ellipsis,) + (slice(None),) * ndims
+    return tuple(Zl.index(t, (Ellipsis, i) + idx[1:])
+                 for i in range(len(pairs)))
+
+
+_FP_LZ = LazyOps(mul=Zl.mul,
+                 mul_many=lambda pairs: _mul_many(Zl.mul, 1, pairs),
+                 is_zero=lambda a: Zl.is_zero_mod(a, 1), ndims=1)
+_FQ2_LZ = LazyOps(mul=T._fq2_mul_lz,
+                  mul_many=lambda pairs: _mul_many(T._fq2_mul_lz, 2,
+                                                   pairs),
+                  is_zero=lambda a: Zl.is_zero_mod(a, 2), ndims=2)
+
+
+def _lz_for(ops: FieldOps) -> LazyOps:
+    return _FP_LZ if ops.ndims == 1 else _FQ2_LZ
+
+
+def _canon_coords(coords):
+    """Canonicalize a tuple of LZ coords with ONE stacked pass.
+    Output: canonical uint32, value < P — the unique representative,
+    so residue-zero Z coordinates come out as EXACT zero limbs (the
+    Jacobian infinity encoding stays sound)."""
+    stacked = Zl.stack(list(coords), axis=0)
+    arr = Zl.canon(stacked)
+    return tuple(arr[i] for i in range(len(coords)))
+
+
 # --- point algebra (generic over the field) --------------------------------
+#
+# Formulas compute on lazy (redundant-form) values: adds/subs/small
+# multiples are single tensor ops, multiplies normalize their own
+# operands, and each formula canonicalizes its output coords once.
+# Boundary contract: point coords are canonical uint32, value < 2P
+# (in practice < P from these formulas / the packers), EXACT zero
+# limbs for infinity Z.
 
 
 def point_double(ops: FieldOps, pt):
-    """dbl-2009-l (a=0).  Infinity (Z=0) stays infinity (Z3=2YZ=0)."""
-    X, Y, Z = pt
-    A = ops.sqr(X)
-    B = ops.sqr(Y)
-    C = ops.sqr(B)
-    t = ops.sqr(ops.add(X, B))
-    D = ops.mul_small(ops.sub(ops.sub(t, A), C), 2)
-    E = ops.mul_small(A, 3)
-    F = ops.sqr(E)
-    X3 = ops.sub(F, ops.mul_small(D, 2))
-    Y3 = ops.sub(ops.mul(E, ops.sub(D, X3)), ops.mul_small(C, 8))
-    Z3 = ops.mul_small(ops.mul(Y, Z), 2)
-    return (X3, Y3, Z3)
+    """dbl-2009-l (a=0).  Infinity (Z=0) stays infinity (Z3=2YZ=0).
+    4 stacked Montgomery-core stages instead of 6 single ones."""
+    lz = _lz_for(ops)
+    X, Y, Z = (Zl.wrap(c) for c in pt)
+    A, B = lz.mul_many([(X, X), (Y, Y)])
+    C, t = lz.mul_many([(B, B), (Zl.add(X, B), Zl.add(X, B))])
+    D = Zl.mul_small(Zl.sub(Zl.sub(t, A), C), 2)
+    E = Zl.mul_small(A, 3)
+    F, YZ = lz.mul_many([(E, E), (Y, Z)])
+    # X3 feeds both the output and D-X3: renormalize ONCE so the
+    # lazy sub-spread constants don't compound (bound tracker blows
+    # up otherwise)
+    X3 = Zl.canon2p(Zl.sub(F, Zl.mul_small(D, 2)))
+    Y3 = Zl.sub(lz.mul(E, Zl.sub(D, X3)), Zl.mul_small(C, 8))
+    Z3 = Zl.mul_small(YZ, 2)
+    return _canon_coords((X3, Y3, Z3))
+
+
+def _add_core(ops: FieldOps, p1, p2):
+    """Shared add-2007-bl core on lazy values.  Returns the raw
+    (X3, Y3, Z3) LZ coords plus the H / (S2-S1) lazy values for the
+    callers' edge-case selects."""
+    lz = _lz_for(ops)
+    X1, Y1, Z1 = (Zl.wrap(c) for c in p1)
+    X2, Y2, Z2 = (Zl.wrap(c) for c in p2)
+    # 7 stacked core stages instead of 11 single calls
+    Z1Z1, Z2Z2 = lz.mul_many([(Z1, Z1), (Z2, Z2)])
+    U1, U2, A1, A2 = lz.mul_many(
+        [(X1, Z2Z2), (X2, Z1Z1), (Y1, Z2), (Y2, Z1)])
+    S1, S2 = lz.mul_many([(A1, Z2Z2), (A2, Z1Z1)])
+    H = Zl.sub(U2, U1)
+    rr = Zl.sub(S2, S1)
+    r = Zl.mul_small(rr, 2)
+    H2 = Zl.mul_small(H, 2)
+    I, R2 = lz.mul_many([(H2, H2), (r, r)])
+    J, V = lz.mul_many([(H, I), (U1, I)])
+    X3 = Zl.canon2p(Zl.sub(Zl.sub(R2, J), Zl.mul_small(V, 2)))
+    YA, YB, Z1Z2 = lz.mul_many(
+        [(r, Zl.sub(V, X3)), (S1, J), (Z1, Z2)])
+    Y3 = Zl.sub(YA, Zl.mul_small(YB, 2))
+    Z3 = lz.mul(Zl.mul_small(Z1Z2, 2), H)
+    return (X3, Y3, Z3), H, rr
 
 
 def point_add(ops: FieldOps, p1, p2):
     """add-2007-bl with branchless edge handling.
 
-    H==0, r!=0 (P == -Q) yields Z3 = 0 — infinity — for free;
+    H==0, r!=0 (P == -Q) yields Z3 == 0 (mod P) — the boundary
+    canonicalization turns that into exact zero limbs, i.e. infinity;
     H==0, r==0 (P == Q) selects the doubling; either input at
     infinity selects the other operand."""
-    X1, Y1, Z1 = p1
-    X2, Y2, Z2 = p2
-    Z1Z1 = ops.sqr(Z1)
-    Z2Z2 = ops.sqr(Z2)
-    U1 = ops.mul(X1, Z2Z2)
-    U2 = ops.mul(X2, Z1Z1)
-    S1 = ops.mul(ops.mul(Y1, Z2), Z2Z2)
-    S2 = ops.mul(ops.mul(Y2, Z1), Z1Z1)
-    H = ops.sub(U2, U1)
-    I = ops.sqr(ops.mul_small(H, 2))
-    J = ops.mul(H, I)
-    r = ops.mul_small(ops.sub(S2, S1), 2)
-    V = ops.mul(U1, I)
-    X3 = ops.sub(ops.sub(ops.sqr(r), J), ops.mul_small(V, 2))
-    Y3 = ops.sub(ops.mul(r, ops.sub(V, X3)),
-                 ops.mul_small(ops.mul(S1, J), 2))
-    Z3 = ops.mul(ops.mul_small(ops.mul(Z1, Z2), 2), H)
-    out = (X3, Y3, Z3)
+    lz = _lz_for(ops)
+    raw, H, rr = _add_core(ops, p1, p2)
+    out = _canon_coords(raw)
 
-    same_x = ops.is_zero(H)
-    same_y = ops.is_zero(ops.sub(S2, S1))
+    same_x = lz.is_zero(H)
+    same_y = lz.is_zero(rr)
     dbl = point_double(ops, p1)
     is_dbl = same_x & same_y
     out = tuple(ops.select(is_dbl, d, o) for d, o in zip(dbl, out))
 
-    p1_inf = ops.is_zero(Z1)
-    p2_inf = ops.is_zero(Z2)
+    p1_inf = ops.is_zero(p1[2])
+    p2_inf = ops.is_zero(p2[2])
     out = tuple(ops.select(p1_inf, b, o) for b, o in zip(p2, out))
     # note: p1_inf wins only if p2 not-inf is fine; if both inf, Z=0 ok
     out = tuple(ops.select(p2_inf & ~p1_inf, a, o)
@@ -127,27 +198,11 @@ def point_add_unequal(ops: FieldOps, p1, p2):
     16k + d << r, so the two are never the same finite point) and for
     small-multiple table building ([d]P == P only if [d-1]P is
     infinity)."""
-    X1, Y1, Z1 = p1
-    X2, Y2, Z2 = p2
-    Z1Z1 = ops.sqr(Z1)
-    Z2Z2 = ops.sqr(Z2)
-    U1 = ops.mul(X1, Z2Z2)
-    U2 = ops.mul(X2, Z1Z1)
-    S1 = ops.mul(ops.mul(Y1, Z2), Z2Z2)
-    S2 = ops.mul(ops.mul(Y2, Z1), Z1Z1)
-    H = ops.sub(U2, U1)
-    I = ops.sqr(ops.mul_small(H, 2))
-    J = ops.mul(H, I)
-    r = ops.mul_small(ops.sub(S2, S1), 2)
-    V = ops.mul(U1, I)
-    X3 = ops.sub(ops.sub(ops.sqr(r), J), ops.mul_small(V, 2))
-    Y3 = ops.sub(ops.mul(r, ops.sub(V, X3)),
-                 ops.mul_small(ops.mul(S1, J), 2))
-    Z3 = ops.mul(ops.mul_small(ops.mul(Z1, Z2), 2), H)
-    out = (X3, Y3, Z3)
+    raw, _H, _rr = _add_core(ops, p1, p2)
+    out = _canon_coords(raw)
 
-    p1_inf = ops.is_zero(Z1)
-    p2_inf = ops.is_zero(Z2)
+    p1_inf = ops.is_zero(p1[2])
+    p2_inf = ops.is_zero(p2[2])
     out = tuple(ops.select(p1_inf, b, o) for b, o in zip(p2, out))
     out = tuple(ops.select(p2_inf & ~p1_inf, a, o)
                 for a, o in zip(p1, out))

@@ -1,0 +1,301 @@
+"""Redundant-form ("lazy") field accumulation for the XLA BLS tower.
+
+Reference analog: blst does field adds/subs with full carry chains in
+asm where they are ~free; in an XLA graph every canonical add/sub
+costs a Kogge-Stone carry prefix plus conditional subtracts — ~183
+jaxpr equations — and the tower/curve formulas issue dozens per
+multiply.  That made graph SIZE the dominant cost of every pairing
+graph (round-3 finding: ONE ``fq12_sqr`` traced to ~6.5k equations /
+~45k HLO instructions; XLA:CPU pays ~25 ms of LLVM codegen per op,
+so a single tower op took 17-430 s to compile, and the slot-verify /
+final-exponentiation graphs minutes to hours).
+
+This module implements the VERDICT r2 #2 "redundant-form
+accumulation" design, with one twist that keeps everything unsigned:
+
+* An ``LZ`` value is a uint32 array of NONNEGATIVE limbs (arbitrary
+  width up to 2**30 per limb, no carry normalization) plus two STATIC
+  bounds: ``hi`` — value upper bound in units of P — and ``lmax`` —
+  per-limb upper bound.  The residue class mod P is what the value
+  means; ops may shift the value by known multiples of P.
+* add / mul_small are single tensor ops.
+* sub(a, b) = a + (S - b) where S is a precomputed "spread" multiple
+  of P whose limb form has every limb >= b's limb bound — so the
+  limb-wise subtraction cannot underflow and the result stays
+  nonnegative.  TWO tensor ops, no carries, value shifted by a known
+  multiple of P (tracked in ``hi``).
+* ``canon2p`` renormalizes (fold passes -> one Kogge-Stone resolve at
+  width 25 -> a Barrett quotient-estimate subtract) to canonical
+  16-bit limbs with value < 2P.  ``canon`` adds one conditional
+  subtract of P, yielding the UNIQUE representative in [0, P) —
+  residue zero comes out as EXACT zero limbs, which is what keeps
+  Jacobian infinity flags (Z == 0) sound at formula boundaries.
+* ``mul`` normalizes operands to canonical < 2P and runs the
+  EXISTING Montgomery core (limbs._mul_columns + product-form
+  reduce) minus its trailing conditional subtract; on TPU it routes
+  through the Mosaic kernel exactly like ``limbs.fp_mul`` (the
+  XLA:TPU fusion-scale miscompile makes the kernel the only correct
+  TPU path).  For operands < a*P, < b*P the product is
+  < (0.102*a*b + 1)*P (P/2**384 ~= 0.1016); operand bounds are kept
+  <= 2 so the 48-column accumulation of T + M*P stays far below
+  2**768, the width the core's final carry resolve is exact for.
+
+LZ values are formula-internal only: they never cross a jit
+boundary, a lax.scan carry, or a public API.  Composite ops (tower
+multiplies, curve point formulas) take and return canonical uint32
+arrays exactly as before.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..params import P
+from . import limbs as L
+
+B = 1 << L.RADIX_BITS          # 2**16
+MASKW = jnp.uint32(B - 1)
+W = L.NLIMBS + 1               # wide (25-limb) form used in canon2p
+
+# P/R rounded UP: the Montgomery shrink factor for static bounds
+P_OVER_R = 0.10158
+
+# Barrett's quotient estimate undershoots by < 1 + 2**376/P + t/2**16
+# with t <= hi*P/2**376 = hi*26.04; the undershoot stays < 2 (so one
+# trailing conditional subtract suffices) while hi*26.04/65536 < 0.95,
+# i.e. hi < 2390.  Cap with margin:
+_HI_CAP = 2000.0
+_LMAX_CAP = 1 << 30
+
+# --- host-side constants ---------------------------------------------------
+
+
+def _int_to_limbs_w(x: int, width: int) -> np.ndarray:
+    assert 0 <= x < 1 << (L.RADIX_BITS * width)
+    return np.array([(x >> (L.RADIX_BITS * i)) & (B - 1)
+                     for i in range(width)], dtype=np.uint32)
+
+
+def _spread_const(floor: int) -> tuple[np.ndarray, int, int]:
+    """(limbs, k, lmax): the smallest multiple k*P of P expressible as
+    24 limbs that are each >= floor.  Used to keep limb-wise
+    subtraction underflow-free."""
+    base = floor * ((1 << L.NBITS) - 1) // (B - 1)   # floor * sum B^i
+    k = -(-base // P)
+    excess = k * P - base
+    d = _int_to_limbs_w(excess, L.NLIMBS) + np.uint32(floor)
+    val = sum(int(v) << (L.RADIX_BITS * i) for i, v in enumerate(d))
+    assert val == k * P and int(d.min()) >= floor
+    return d, k, int(d.max())
+
+
+_SPREADS: dict = {}
+
+
+def _spread(floor: int):
+    """Spread constant for a per-limb floor, quantized up to the next
+    power of two to bound the cache."""
+    f = 1 << max(16, int(floor - 1).bit_length())
+    if f not in _SPREADS:
+        # cache NUMPY constants — caching device arrays created inside
+        # a jit trace would leak tracers into later traces
+        _SPREADS[f] = _spread_const(f - 1)
+    arr, k, lmax = _SPREADS[f]
+    return jnp.asarray(arr), k, lmax
+
+
+# Barrett constant: q_hat = (top24bits(v) * K) >> 16 with
+# K = floor(2**392 / P) underestimates floor(v / P) by at most 1 for
+# v < _HI_CAP * P (see _barrett).
+BARRETT_K = (1 << 392) // P
+assert BARRETT_K < 1 << 12
+
+
+def _qp_table(qmax: int) -> np.ndarray:
+    return np.stack([_int_to_limbs_w(q * P, W) for q in range(qmax + 1)])
+
+
+_QP_CACHE: dict = {}
+
+# --- the lazy value --------------------------------------------------------
+
+
+class LZ:
+    """Nonnegative redundant limb value with static bounds.
+
+    arr: uint32[..., NLIMBS]; value in [0, hi*P) — hi is a STRICT
+    bound; limbs in [0, lmax] inclusive.  Purely trace-time — never
+    crosses jit boundaries.
+
+    ``_norm`` memoizes this value's canon2p form so a lazy operand
+    feeding several multiplies (H, r in the point-add formulas) is
+    canonicalized once per trace instead of once per use."""
+
+    __slots__ = ("arr", "hi", "lmax", "_norm")
+
+    def __init__(self, arr, hi: float, lmax: int):
+        assert lmax < _LMAX_CAP, "limb bound overflows uint32 headroom"
+        assert 0.0 <= hi <= _HI_CAP, f"value bound blown: {hi}"
+        self.arr = arr
+        self.hi = hi
+        self.lmax = lmax
+        self._norm = None
+
+    @property
+    def canonical16(self) -> bool:
+        return self.lmax <= B - 1
+
+
+def wrap(arr_u32, hi: float = 2.0) -> LZ:
+    """Canonical uint32 limbs -> LZ (free)."""
+    return LZ(arr_u32, hi, B - 1)
+
+
+def _add_arr(x, y):
+    """Elementwise add binding lax directly when no broadcast is
+    needed (jnp wrappers cost ~7x the trace time — see limbs.py)."""
+    from jax import lax
+
+    if x.shape == y.shape and x.dtype == y.dtype:
+        return lax.add(x, y)
+    return x + y
+
+
+def add(a: LZ, b: LZ) -> LZ:
+    return LZ(_add_arr(a.arr, b.arr), a.hi + b.hi, a.lmax + b.lmax)
+
+
+def sub(a: LZ, b: LZ) -> LZ:
+    """a - b + k*P with k*P the spread constant covering b's limbs."""
+    s_arr, s_k, s_lmax = _spread(b.lmax + 1)
+    return LZ(_add_arr(a.arr, s_arr - b.arr), a.hi + float(s_k),
+              a.lmax + s_lmax)
+
+
+def neg(a: LZ) -> LZ:
+    s_arr, s_k, s_lmax = _spread(a.lmax + 1)
+    return LZ(s_arr - a.arr, float(s_k), s_lmax)
+
+
+def mul_small(a: LZ, k: int) -> LZ:
+    assert k >= 0
+    return LZ(a.arr * jnp.uint32(k), a.hi * k, a.lmax * k)
+
+
+def select(cond, a: LZ, b: LZ, ndims: int = 1) -> LZ:
+    """where(cond, a, b); cond shaped like the batch dims, ndims =
+    trailing non-batch dims (1 for Fp limbs, 2 for Fq2 coeff+limbs)."""
+    c = jnp.expand_dims(cond, tuple(range(-ndims, 0)))
+    return LZ(jnp.where(c, a.arr, b.arr), max(a.hi, b.hi),
+              max(a.lmax, b.lmax))
+
+
+def stack(values, axis: int) -> LZ:
+    return LZ(jnp.stack([v.arr for v in values], axis=axis),
+              max(v.hi for v in values), max(v.lmax for v in values))
+
+
+def index(a: LZ, idx) -> LZ:
+    return LZ(a.arr[idx], a.hi, a.lmax)
+
+
+# --- normalization ---------------------------------------------------------
+
+
+def _barrett(v, hi: float):
+    """v: canonical nonneg width-25 uint32 limbs, value < hi*P.
+    Returns (value mod-P-shifted into [0, 2P)) as width-24 limbs.
+
+    q_hat = (t*K) >> 16 with t = bits [376:400) of v and
+    K = floor(2**392/P):
+      q_hat <= t*2**392/(P*2**16) = t*2**376/P <= v/P = q + frac.
+    Undershoot: q - q_hat < 1 + 2**376/P + t*2**-16
+    < 1 + 0.034 + _HI_CAP*P*2**-376*2**-16 < 2 for hi <= _HI_CAP,
+    so q - q_hat is 0 or 1 and the result v - q_hat*P < 2P."""
+    assert hi <= _HI_CAP
+    qmax = int(np.floor(hi))
+    if qmax not in _QP_CACHE:
+        _QP_CACHE[qmax] = _qp_table(qmax)         # numpy: see _spread
+    table = jnp.asarray(_QP_CACHE[qmax])          # (qmax+1, 25)
+    t = (v[..., 23] >> 8) | (v[..., 24] << 8)     # bits 376..400
+    q_hat = (t * jnp.uint32(BARRETT_K)) >> 16
+    oh_shape = (qmax + 1,) + (1,) * v.ndim
+    qvals = jnp.arange(qmax + 1, dtype=jnp.uint32).reshape(oh_shape)
+    onehot = (q_hat[None, ..., None] == qvals).astype(jnp.uint32)
+    qp = jnp.sum(jnp.reshape(table, (qmax + 1,) + (1,) * (v.ndim - 1)
+                             + (W,)) * onehot, axis=0)
+    # exact wide subtract v - qp (v >= qp): two's complement, the
+    # final carry out of limb 24 is the +1 that completes it
+    s = v + (MASKW - qp)
+    one = jnp.zeros_like(s).at[..., 0].set(jnp.uint32(1))
+    s = L._fold_once(s + one)                     # entries <= 2**16
+    out, _ = L._carry_resolve(s, W)
+    return out[..., :L.NLIMBS]                    # < 2P < 2**384
+
+
+def canon2p(a: LZ) -> LZ:
+    """Any LZ -> canonical 16-bit limbs, value < 2P, same residue."""
+    if a.canonical16 and a.hi <= 2.0:
+        return a
+    if a._norm is not None:
+        return a._norm
+    from jax import lax
+
+    x = lax.pad(a.arr, np.uint32(0),
+                [(0, 0, 0)] * (a.arr.ndim - 1) + [(0, 1, 0)])  # w 25
+    lmax = a.lmax
+    # Value < hi*P < 2**389 and limbs nonneg, so limb 24 stays far
+    # below 2**16 and each pass's top carry-out is provably zero:
+    # the squeeze loses nothing.
+    while lmax > B:
+        x = L._fold_once(x)
+        lmax = (B - 1) + (lmax >> L.RADIX_BITS)
+    v, _ = L._carry_resolve(x, W)
+    out = LZ(_barrett(v, max(a.hi, 2.0)), 2.0, B - 1)
+    a._norm = out
+    return out
+
+
+def canon(a: LZ):
+    """LZ -> the unique canonical representative in [0, P), uint32.
+    Residue zero comes out as EXACT zero limbs."""
+    c = canon2p(a)
+    d, borrow = L._sub_borrow(c.arr, jnp.asarray(L.P_LIMBS))
+    return jnp.where((borrow == 0)[..., None], d, c.arr)
+
+
+def is_zero_mod(a: LZ, ndims: int = 1):
+    """value == 0 (mod P), reduced over trailing element+limb dims."""
+    axes = tuple(range(-ndims, 0))
+    return jnp.all(canon(a) == 0, axis=axes)
+
+
+# --- multiplication --------------------------------------------------------
+
+
+def norm_operand(a: LZ) -> LZ:
+    """Normalize an LZ into a valid mul operand (canonical 16-bit
+    limbs, value < 2P)."""
+    if a.canonical16 and a.hi <= 2.0:
+        return a
+    return canon2p(a)
+
+
+def mul(a: LZ, b: LZ) -> LZ:
+    """Montgomery product -> LZ with canonical 16-bit limbs.
+    XLA core: value < (0.102*4 + 1)*P < 1.41P; TPU kernel: < P."""
+    a = norm_operand(a)
+    b = norm_operand(b)
+    if jax.default_backend() == "tpu" or L.get_mul_backend() == "pallas":
+        from .pallas_mont import mont_mul_pallas
+
+        return LZ(mont_mul_pallas(a.arr, b.arr), 1.0, B - 1)
+    out = L._mont_reduce(L._mul_columns(a.arr, b.arr), csub=False)
+    return LZ(out, P_OVER_R * a.hi * b.hi + 1.0, B - 1)
+
+
+def sqr(a: LZ) -> LZ:
+    return mul(a, a)

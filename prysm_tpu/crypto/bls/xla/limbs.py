@@ -77,13 +77,24 @@ R2_LIMBS = int_to_limbs_np(R2_MOD_P)
 ZERO = np.zeros(NLIMBS, dtype=np.uint32)
 
 # --- carry / compare helpers ----------------------------------------------
+#
+# These helpers trace thousands of times per pairing graph, so they
+# bind lax primitives directly: each jnp wrapper call costs ~7x more
+# trace time (a pjit-wrapper dispatch) and sprinkles broadcast/convert
+# equations through the graph (round-3 measurement: the slot pipeline
+# traced 119k wrapper events in ~50 s of pure Python).
+
+
+def _full(x, v: int):
+    """Same-shape uint32 constant (lax ops do not broadcast)."""
+    return lax.full(x.shape, np.uint32(v), np.dtype(np.uint32))
 
 
 def _shift_up(x, k: int = 1, fill: int = 0):
     """Shift limbs toward the more-significant end by k positions
     (``fill`` at the bottom): out[i] = x[i-k]."""
-    pads = [(0, 0)] * (x.ndim - 1) + [(k, 0)]
-    return jnp.pad(x[..., :-k], pads, constant_values=fill)
+    cfg = [(0, 0, 0)] * (x.ndim - 1) + [(k, -k, 0)]
+    return lax.pad(x, np.uint32(fill), cfg)
 
 
 def _carry_resolve(x, n: int):
@@ -99,20 +110,32 @@ def _carry_resolve(x, n: int):
     prefix resolves in ceil(log2 n) steps instead of an n-step scan —
     the n-step lax.scan ripple was the dominant serialization of every
     field multiply on TPU."""
-    g = x >> RADIX_BITS                      # 0/1
-    p = (x & MASK32) == MASK32
-    p = p.astype(jnp.uint32)
+    c16 = _full(x, RADIX_BITS)
+    mask = _full(x, RADIX - 1)
+    g = lax.shift_right_logical(x, c16)      # 0/1
+    p = lax.convert_element_type(
+        lax.eq(lax.bitwise_and(x, mask), mask), np.uint32)
     shift = 1
     while shift < n:
         # identity element is (g=0, p=1)
         gs = _shift_up(g, shift)
         ps = _shift_up(p, shift, fill=1)
-        g = g | (p & gs)
-        p = p & ps
+        g = lax.bitwise_or(g, lax.bitwise_and(p, gs))
+        p = lax.bitwise_and(p, ps)
         shift *= 2
     carry_in = _shift_up(g)                  # c[i] = G[i-1], c[0] = 0
-    out = (x + carry_in) & MASK32
+    out = lax.bitwise_and(lax.add(x, carry_in), mask)
     return out, g[..., -1]
+
+
+def _fold_once(x):
+    """One value-preserving squeeze: each limb's high part carries up
+    one position.  The top limb's own high part is DROPPED (callers
+    guarantee it is zero or rely on the mod-2**(16*n) wrap)."""
+    c16 = _full(x, RADIX_BITS)
+    mask = _full(x, RADIX - 1)
+    return lax.add(lax.bitwise_and(x, mask),
+                   _shift_up(lax.shift_right_logical(x, c16)))
 
 
 def _carry_norm(cols, n_out: int):
@@ -126,8 +149,7 @@ def _carry_norm(cols, n_out: int):
     Two fold passes squeeze every limb to <= 2**16 (one pending carry
     at most), then _carry_resolve finishes in log depth."""
     x = cols[..., :n_out]
-    for _ in range(2):
-        x = (x & MASK32) + _shift_up(x >> RADIX_BITS)
+    x = _fold_once(_fold_once(x))
     out, _ = _carry_resolve(x, n_out)
     return out
 
@@ -137,15 +159,18 @@ def _sub_borrow(a, b_limbs):
 
     Two's-complement formulation so the log-depth carry resolver does
     the work: a - b = a + ~b + 1 with borrow = NOT carry-out."""
-    b = jnp.broadcast_to(b_limbs, a.shape)
-    s = a + (MASK32 - b)                     # entries <= 2**17 - 2
-    one = jnp.zeros_like(s).at[..., 0].set(jnp.uint32(1))
-    s = s + one
-    hi = s >> RADIX_BITS
+    b = jnp.broadcast_to(b_limbs, a.shape).astype(jnp.uint32)
+    mask = _full(a, RADIX - 1)
+    s = lax.add(a, lax.sub(mask, b))         # entries <= 2**17 - 2
+    one = lax.pad(
+        lax.full(a.shape[:-1] + (1,), np.uint32(1), np.dtype(np.uint32)),
+        np.uint32(0), [(0, 0, 0)] * (a.ndim - 1) + [(0, a.shape[-1] - 1, 0)])
+    s = lax.add(s, one)
+    hi = lax.shift_right_logical(s, _full(s, RADIX_BITS))
     # the fold's _shift_up DROPS the top limb's own carry — it is part
     # of the 385th bit and must count toward the final carry-out
     top_carry = hi[..., -1]
-    s = (s & MASK32) + _shift_up(hi)         # fold: <= 2**16
+    s = lax.add(lax.bitwise_and(s, mask), _shift_up(hi))  # <= 2**16
     diff, carry_out = _carry_resolve(s, a.shape[-1])
     return diff, jnp.uint32(1) - (top_carry | carry_out)
 
@@ -276,12 +301,16 @@ def _mul_low(a, b):
     return _carry_norm(_mul_columns(a, b, low_only=True), NLIMBS)
 
 
-def _mont_reduce(cols):
+def _mont_reduce(cols, csub: bool = True):
     """Montgomery-reduce 48 redundant product columns -> canonical 24
     limbs, in product form: M = (T mod R) * (-P^-1 mod R) mod R, then
     result = (T + M*P) / R.  Two big vectorized multiplies instead of a
     24-step sequential loop — far better for XLA compile time and TPU
-    vectorization than interleaved CIOS."""
+    vectorization than interleaved CIOS.
+
+    ``csub=False`` skips the trailing conditional subtract — the
+    redundant-form callers (lazy.py) track the < (T/(R*P) + 1)*P bound
+    statically and normalize later, where it batches."""
     t_lo = _carry_norm(cols[..., :NLIMBS], NLIMBS)
     m = _mul_low(t_lo, jnp.asarray(NPRIME_LIMBS))
     mp = _mul_columns(m, jnp.broadcast_to(jnp.asarray(P_LIMBS), m.shape))
@@ -289,7 +318,7 @@ def _mont_reduce(cols):
     # low 24 columns of (T + M*P) are == 0 mod 2**384 by construction;
     # normalize the full 48 so their carries flow into the high half.
     limbs = _carry_norm(total, 2 * NLIMBS)[..., NLIMBS:]
-    return _csub_p(limbs)
+    return _csub_p(limbs) if csub else limbs
 
 
 # The Montgomery-multiply backend is swappable: "xla" is the fused
@@ -461,10 +490,19 @@ def rand_canonical(seed: int, shape) -> jnp.ndarray:
 
 
 def pack_ints(values, mont: bool = True) -> jnp.ndarray:
-    """List/array of Python ints -> uint32[n, 24] (Montgomery by default)."""
-    arr = np.stack([int_to_limbs_np(v % P) for v in values])
-    out = jnp.asarray(arr)
-    return to_mont(out) if mont else out
+    """List/array of Python ints -> uint32[n, 24] (Montgomery by default).
+
+    The Montgomery conversion happens in HOST integer math: packing is
+    glue, not compute, and routing it through a device ``to_mont``
+    dispatched one tiny XLA compile per call-site shape — hundreds of
+    sub-second compiles per process that the persistent cache never
+    holds (below its min-compile-time threshold)."""
+    if mont:
+        arr = np.stack([int_to_limbs_np((v * R_MOD_P) % P)
+                        for v in values])
+    else:
+        arr = np.stack([int_to_limbs_np(v % P) for v in values])
+    return jnp.asarray(arr)
 
 
 def unflatten_list(shape, items) -> list:
@@ -480,11 +518,17 @@ def unflatten_list(shape, items) -> list:
     return build(tuple(shape))
 
 
+R_INV_MOD_P = pow(R_MOD_P, -1, P)
+
+
 def unpack_ints(limbs, mont: bool = True) -> list:
-    """uint32[..., 24] -> nested lists of Python ints."""
-    if mont:
-        limbs = from_mont(limbs)
+    """uint32[..., 24] -> nested lists of Python ints.
+
+    Like pack_ints, the Montgomery conversion is host integer math —
+    unpacking is glue and must not dispatch device compiles."""
     arr = np.asarray(jax.device_get(limbs))
     flat = arr.reshape(-1, NLIMBS)
     ints = [limbs_to_int(row) for row in flat]
+    if mont:
+        ints = [(v * R_INV_MOD_P) % P for v in ints]
     return unflatten_list(arr.shape[:-1], ints)

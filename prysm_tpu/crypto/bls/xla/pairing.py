@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..params import BLS_X_ABS, P, R
+from . import lazy as Zl
 from . import limbs as L
 from . import tower as T
 from .curve import FQ2_OPS, point_double
@@ -68,54 +69,87 @@ def _line_to_fq12(s00, s11, s12):
     return jnp.stack([c0, c1], axis=-4)
 
 
+def _fp_pair(s: "Zl.LZ") -> "Zl.LZ":
+    """Fp scalar -> (s, s) along the Fq2 coefficient axis."""
+    return Zl.stack([s, s], axis=-2)
+
+
+def _mul_many_fq2(pairs):
+    """Independent Fq2 multiplies of one step stage as ONE stacked
+    Karatsuba/Montgomery call (see curve._mul_many)."""
+    la = Zl.stack([a for a, _ in pairs], axis=-3)
+    lb = Zl.stack([b for _, b in pairs], axis=-3)
+    t = T._fq2_mul_lz(la, lb)
+    return tuple(Zl.index(t, (Ellipsis, i, slice(None), slice(None)))
+                 for i in range(len(pairs)))
+
+
 def _dbl_step(t, xp, yp):
     """Double T and evaluate the tangent line at P=(xp, yp) (Fp).
 
-    Returns (T2, line_fq12)."""
-    X, Y, Z = t
-    A = T.fq2_sqr(X)                       # X^2
-    B = T.fq2_sqr(Y)                       # Y^2
-    ZZ = T.fq2_sqr(Z)
-    C = T.fq2_sqr(B)                       # Y^4
-    E = T.fq2_mul_small(A, 3)              # 3X^2
-    D = T.fq2_mul_small(
-        T.fq2_sub(T.fq2_sub(T.fq2_sqr(T.fq2_add(X, B)), A), C), 2)
-    F = T.fq2_sqr(E)
-    X3 = T.fq2_sub(F, T.fq2_mul_small(D, 2))
-    Y3 = T.fq2_sub(T.fq2_mul(E, T.fq2_sub(D, X3)), T.fq2_mul_small(C, 8))
-    Z3 = T.fq2_mul_small(T.fq2_mul(Y, Z), 2)
+    Returns (T2, line_fq12).  Runs on the redundant-form (lazy.py)
+    domain — this body IS the Miller scan, the deepest compile-critical
+    graph in slot verification — with ONE stacked canonicalization for
+    the three output coords and three line slots."""
+    X, Y, Z = (Zl.wrap(c) for c in t)
+    xpw, ypw = Zl.wrap(xp), Zl.wrap(yp)
+    mm = _mul_many_fq2
+    A, B, ZZ = mm([(X, X), (Y, Y), (Z, Z)])
+    XB = Zl.add(X, B)
+    C, t2 = mm([(B, B), (XB, XB)])          # Y^4, (X+Y^2)^2
+    E = Zl.mul_small(A, 3)                  # 3X^2
+    D = Zl.mul_small(Zl.sub(Zl.sub(t2, A), C), 2)
+    F, YZ = mm([(E, E), (Y, Z)])
+    X3 = Zl.canon2p(Zl.sub(F, Zl.mul_small(D, 2)))  # reused: D-X3
+    Z3 = Zl.mul_small(YZ, 2)
+    Y3m, c_y, c_x, EX = mm(
+        [(E, Zl.sub(D, X3)), (Z3, ZZ), (E, ZZ), (E, X)])
+    Y3 = Zl.sub(Y3m, Zl.mul_small(C, 8))
+    c_0 = Zl.sub(Zl.mul_small(B, 2), EX)
 
     # line coefficients (see module docstring)
-    c_y = T.fq2_mul(Z3, ZZ)
-    c_x = T.fq2_mul(E, ZZ)
-    c_0 = T.fq2_sub(T.fq2_mul_small(B, 2), T.fq2_mul(E, X))
-    s00 = T.fq2_mul_by_xi(T.fq2_mul_fp(c_y, yp))
-    s12 = T.fq2_neg(T.fq2_mul_fp(c_x, xp))
-    s11 = T.fq2_neg(c_0)
-    return (X3, Y3, Z3), _line_to_fq12(s00, s11, s12)
+    lp = Zl.mul(Zl.stack([c_y, c_x], axis=-3),
+                Zl.stack([_fp_pair(ypw), _fp_pair(xpw)], axis=-3))
+    s00 = T._fq2_xi_lz(Zl.index(lp, (Ellipsis, 0, slice(None),
+                                     slice(None))))
+    s12 = Zl.neg(Zl.index(lp, (Ellipsis, 1, slice(None),
+                               slice(None))))
+    s11 = Zl.neg(c_0)
+    arr = Zl.canon(Zl.stack([X3, Y3, Z3, s00, s11, s12], axis=0))
+    return ((arr[0], arr[1], arr[2]),
+            _line_to_fq12(arr[3], arr[4], arr[5]))
 
 
 def _add_step(t, q_aff, xp, yp):
-    """Mixed-add affine Q into Jacobian T; line through T and Q at P."""
-    x2, y2 = q_aff
-    X, Y, Z = t
-    ZZ = T.fq2_sqr(Z)
-    U2 = T.fq2_mul(x2, ZZ)
-    S2 = T.fq2_mul(T.fq2_mul(y2, Z), ZZ)
-    H = T.fq2_sub(U2, X)
-    Rr = T.fq2_sub(S2, Y)
-    HH = T.fq2_sqr(H)
-    HHH = T.fq2_mul(H, HH)
-    V = T.fq2_mul(X, HH)
-    X3 = T.fq2_sub(T.fq2_sub(T.fq2_sqr(Rr), HHH), T.fq2_mul_small(V, 2))
-    Y3 = T.fq2_sub(T.fq2_mul(Rr, T.fq2_sub(V, X3)), T.fq2_mul(Y, HHH))
-    Z3 = T.fq2_mul(Z, H)
+    """Mixed-add affine Q into Jacobian T; line through T and Q at P.
+    Lazy-domain body, one stacked boundary canonicalization."""
+    x2, y2 = (Zl.wrap(c) for c in q_aff)
+    X, Y, Z = (Zl.wrap(c) for c in t)
+    xpw, ypw = Zl.wrap(xp), Zl.wrap(yp)
+    fm, mm = T._fq2_mul_lz, _mul_many_fq2
+    ZZ = T._fq2_sqr_lz(Z)
+    U2, SZ = mm([(x2, ZZ), (y2, Z)])
+    S2 = fm(SZ, ZZ)
+    H = Zl.sub(U2, X)
+    Rr = Zl.sub(S2, Y)
+    HH, R2 = mm([(H, H), (Rr, Rr)])
+    HHH, V, Z3 = mm([(H, HH), (X, HH), (Z, H)])
+    X3 = Zl.canon2p(Zl.sub(Zl.sub(R2, HHH), Zl.mul_small(V, 2)))
+    RVX, YH, Zy2, Rx2 = mm(
+        [(Rr, Zl.sub(V, X3)), (Y, HHH), (Z3, y2), (Rr, x2)])
+    Y3 = Zl.sub(RVX, YH)
+    c_0 = Zl.sub(Zy2, Rx2)
 
-    c_0 = T.fq2_sub(T.fq2_mul(Z3, y2), T.fq2_mul(Rr, x2))
-    s00 = T.fq2_mul_by_xi(T.fq2_mul_fp(Z3, yp))
-    s12 = T.fq2_neg(T.fq2_mul_fp(Rr, xp))
-    s11 = T.fq2_neg(c_0)
-    return (X3, Y3, Z3), _line_to_fq12(s00, s11, s12)
+    lp = Zl.mul(Zl.stack([Z3, Rr], axis=-3),
+                Zl.stack([_fp_pair(ypw), _fp_pair(xpw)], axis=-3))
+    s00 = T._fq2_xi_lz(Zl.index(lp, (Ellipsis, 0, slice(None),
+                                     slice(None))))
+    s12 = Zl.neg(Zl.index(lp, (Ellipsis, 1, slice(None),
+                               slice(None))))
+    s11 = Zl.neg(c_0)
+    arr = Zl.canon(Zl.stack([X3, Y3, Z3, s00, s11, s12], axis=0))
+    return ((arr[0], arr[1], arr[2]),
+            _line_to_fq12(arr[3], arr[4], arr[5]))
 
 
 @jax.jit
@@ -219,7 +253,9 @@ def _pow_abs_x(f):
     bits = jnp.asarray(np.array(X_BITS, dtype=np.uint32))
 
     def body(acc, bit):
-        acc = T.fq12_sqr(acc)
+        # Granger-Scott: every caller sits in the cyclotomic subgroup
+        # (post-easy-part), where squaring is 9 Fq2 squarings
+        acc = T.fq12_cyclotomic_sqr(acc)
         acc = lax.cond(bit == 1, lambda a: T.fq12_mul(a, f),
                        lambda a: a, acc)
         return acc, None
@@ -254,7 +290,7 @@ def final_exponentiation_check(f):
     c_x2 = _pow_abs_x(_pow_abs_x(c))                    # c^(x^2)
     a = T.fq12_mul(T.fq12_mul(c_x2, T.fq12_frobenius(c, 2)),
                    T.fq12_conj(c))                      # c^(x^2+p^2-1)
-    m3 = T.fq12_mul(T.fq12_sqr(m), m)                   # m^3
+    m3 = T.fq12_mul(T.fq12_cyclotomic_sqr(m), m)        # m^3
     return T.fq12_mul(a, m3)
 
 

@@ -29,6 +29,7 @@ from jax import lax
 
 from ..params import P
 from ..pure import fields as pf
+from . import lazy as Z
 from . import limbs as L
 
 # --- packing: pure-model objects <-> device arrays -------------------------
@@ -119,28 +120,64 @@ def fq2_mul_by_xi(a):
     return jnp.stack([L.fp_sub(c0, c1), L.fp_add(c0, c1)], axis=-2)
 
 
+# --- LZ-level Fq2 cores (redundant-form internals, lazy.py) ---------------
+#
+# Each core takes/returns lazy.LZ values shaped (..., 2, 24) (the Fq2
+# coefficient axis at -2) and performs NO canonicalization of its
+# outputs: adds/subs are single tensor ops, the one batched Montgomery
+# multiply normalizes its stacked operands itself, and the caller
+# canonicalizes once at its own boundary.  This is what keeps a full
+# Fq12 multiply at ~600 jaxpr equations instead of ~6200.
+
+
+def _lz_c(a: Z.LZ, i: int) -> Z.LZ:
+    return Z.index(a, (Ellipsis, i, slice(None)))
+
+
+def _lz_fq2(c0: Z.LZ, c1: Z.LZ) -> Z.LZ:
+    return Z.stack([c0, c1], axis=-2)
+
+
+def _fq2_mul_lz(a: Z.LZ, b: Z.LZ) -> Z.LZ:
+    """Karatsuba: ONE batched Montgomery mul of 3 stacked operands."""
+    a0, a1 = _lz_c(a, 0), _lz_c(a, 1)
+    b0, b1 = _lz_c(b, 0), _lz_c(b, 1)
+    la = Z.stack([a0, a1, Z.add(a0, a1)], axis=-2)
+    lb = Z.stack([b0, b1, Z.add(b0, b1)], axis=-2)
+    t = Z.mul(la, lb)
+    t0, t1, t2 = (Z.index(t, (Ellipsis, i, slice(None)))
+                  for i in range(3))
+    c0 = Z.sub(t0, t1)
+    c1 = Z.sub(Z.sub(t2, t0), t1)
+    return _lz_fq2(c0, c1)
+
+
+def _fq2_sqr_lz(a: Z.LZ) -> Z.LZ:
+    """(a0+a1)(a0-a1), 2*a0*a1 — 2 stacked Fp muls."""
+    a0, a1 = _lz_c(a, 0), _lz_c(a, 1)
+    la = Z.stack([Z.add(a0, a1), Z.mul_small(a0, 2)], axis=-2)
+    lb = Z.stack([Z.sub(a0, a1), a1], axis=-2)
+    t = Z.mul(la, lb)
+    return _lz_fq2(Z.index(t, (Ellipsis, 0, slice(None))),
+                   Z.index(t, (Ellipsis, 1, slice(None))))
+
+
+def _fq2_xi_lz(a: Z.LZ) -> Z.LZ:
+    """xi = 1 + u: (c0 - c1) + (c0 + c1) u, lazily."""
+    c0, c1 = _lz_c(a, 0), _lz_c(a, 1)
+    return _lz_fq2(Z.sub(c0, c1), Z.add(c0, c1))
+
+
 @jax.jit
 def fq2_mul(a, b):
-    """Karatsuba: 3 Fp muls in one stacked call."""
-    a0, a1 = a[..., 0, :], a[..., 1, :]
-    b0, b1 = b[..., 0, :], b[..., 1, :]
-    la = jnp.stack([a0, a1, L.fp_add(a0, a1)], axis=-2)
-    lb = jnp.stack([b0, b1, L.fp_add(b0, b1)], axis=-2)
-    t = L.fp_mul(la, lb)
-    t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
-    c0 = L.fp_sub(t0, t1)
-    c1 = L.fp_sub(L.fp_sub(t2, t0), t1)
-    return jnp.stack([c0, c1], axis=-2)
+    """Karatsuba: 3 Fp muls in one stacked call (lazy internals, ONE
+    boundary canonicalization -> unique representatives < P)."""
+    return Z.canon(_fq2_mul_lz(Z.wrap(a), Z.wrap(b)))
 
 
 @jax.jit
 def fq2_sqr(a):
-    """(a0+a1)(a0-a1), 2*a0*a1 — 2 Fp muls in one stacked call."""
-    a0, a1 = a[..., 0, :], a[..., 1, :]
-    la = jnp.stack([L.fp_add(a0, a1), L.fp_add(a0, a0)], axis=-2)
-    lb = jnp.stack([L.fp_sub(a0, a1), a1], axis=-2)
-    t = L.fp_mul(la, lb)
-    return jnp.stack([t[..., 0, :], t[..., 1, :]], axis=-2)
+    return Z.canon(_fq2_sqr_lz(Z.wrap(a)))
 
 
 @jax.jit
@@ -198,22 +235,41 @@ def fq6_neg(a):
     return L.fp_neg(a)
 
 
+def _lz_d(a: Z.LZ, i: int) -> Z.LZ:
+    return Z.index(a, (Ellipsis, i, slice(None), slice(None)))
+
+
+def _fq6_mul_lz(a: Z.LZ, b: Z.LZ) -> Z.LZ:
+    """Toom/Karatsuba 6-mul schedule: ONE stacked _fq2_mul_lz call
+    (so ONE batched Montgomery multiply for all 18 Fp products)."""
+    a0, a1, a2 = (_lz_d(a, i) for i in range(3))
+    b0, b1, b2 = (_lz_d(b, i) for i in range(3))
+    la = Z.stack([a0, a1, a2, Z.add(a1, a2), Z.add(a0, a1),
+                  Z.add(a0, a2)], axis=-3)
+    lb = Z.stack([b0, b1, b2, Z.add(b1, b2), Z.add(b0, b1),
+                  Z.add(b0, b2)], axis=-3)
+    # one canon2p per level keeps the sub-spread constants (k*P per
+    # lazy subtraction) from compounding through the nesting — without
+    # it the tracked bounds grow ~5x per level
+    t = Z.canon2p(_fq2_mul_lz(la, lb))
+    t0, t1, t2, t12, t01, t02 = (_lz_d(t, i) for i in range(6))
+    c0 = Z.add(t0, _fq2_xi_lz(Z.sub(Z.sub(t12, t1), t2)))
+    c1 = Z.add(Z.sub(Z.sub(t01, t0), t1), _fq2_xi_lz(t2))
+    c2 = Z.add(Z.sub(Z.sub(t02, t0), t2), t1)
+    return Z.stack([c0, c1, c2], axis=-3)
+
+
+def _fq6_v_lz(a: Z.LZ) -> Z.LZ:
+    """(d0, d1, d2) -> (xi*d2, d0, d1), lazily."""
+    return Z.stack([_fq2_xi_lz(_lz_d(a, 2)), _lz_d(a, 0),
+                    _lz_d(a, 1)], axis=-3)
+
+
 @jax.jit
 def fq6_mul(a, b):
-    """Toom/Karatsuba 6-mul schedule, one stacked fq2_mul call."""
-    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
-    b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
-    la = jnp.stack([a0, a1, a2, fq2_add(a1, a2), fq2_add(a0, a1),
-                    fq2_add(a0, a2)], axis=-3)
-    lb = jnp.stack([b0, b1, b2, fq2_add(b1, b2), fq2_add(b0, b1),
-                    fq2_add(b0, b2)], axis=-3)
-    t = fq2_mul(la, lb)
-    t0, t1, t2 = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
-    t12, t01, t02 = t[..., 3, :, :], t[..., 4, :, :], t[..., 5, :, :]
-    c0 = fq2_add(t0, fq2_mul_by_xi(fq2_sub(fq2_sub(t12, t1), t2)))
-    c1 = fq2_add(fq2_sub(fq2_sub(t01, t0), t1), fq2_mul_by_xi(t2))
-    c2 = fq2_add(fq2_sub(fq2_sub(t02, t0), t2), t1)
-    return jnp.stack([c0, c1, c2], axis=-3)
+    """Toom/Karatsuba 6-mul schedule, one stacked Montgomery call
+    (lazy internals, one boundary canonicalization)."""
+    return Z.canon(_fq6_mul_lz(Z.wrap(a), Z.wrap(b)))
 
 
 @jax.jit
@@ -285,15 +341,26 @@ def fq12_mul(a, b):
         from .pallas_tower import fq12_mul_pallas
 
         return fq12_mul_pallas(a, b)
-    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
-    b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
-    la = jnp.stack([a0, a1, fq6_add(a0, a1)], axis=-4)
-    lb = jnp.stack([b0, b1, fq6_add(b0, b1)], axis=-4)
-    t = fq6_mul(la, lb)
-    t0, t1, t2 = t[..., 0, :, :, :], t[..., 1, :, :, :], t[..., 2, :, :, :]
-    c0 = fq6_add(t0, fq6_mul_by_v(t1))
-    c1 = fq6_sub(fq6_sub(t2, t0), t1)
-    return jnp.stack([c0, c1], axis=-4)
+    return Z.canon(_fq12_mul_lz(Z.wrap(a), Z.wrap(b)))
+
+
+def _lz_w(a: Z.LZ, i: int) -> Z.LZ:
+    return Z.index(a, (Ellipsis, i, slice(None), slice(None),
+                       slice(None)))
+
+
+def _fq12_mul_lz(a: Z.LZ, b: Z.LZ) -> Z.LZ:
+    """Karatsuba over Fq6: ONE batched Montgomery multiply for all 54
+    Fp products of a full Fq12 multiply."""
+    a0, a1 = _lz_w(a, 0), _lz_w(a, 1)
+    b0, b1 = _lz_w(b, 0), _lz_w(b, 1)
+    la = Z.stack([a0, a1, Z.add(a0, a1)], axis=-4)
+    lb = Z.stack([b0, b1, Z.add(b0, b1)], axis=-4)
+    t = Z.canon2p(_fq6_mul_lz(la, lb))     # see _fq6_mul_lz on spreads
+    t0, t1, t2 = (_lz_w(t, i) for i in range(3))
+    c0 = Z.add(t0, _fq6_v_lz(t1))
+    c1 = Z.sub(Z.sub(t2, t0), t1)
+    return Z.stack([c0, c1], axis=-4)
 
 
 @jax.jit
@@ -304,15 +371,59 @@ def fq12_sqr(a):
         from .pallas_tower import fq12_sqr_pallas
 
         return fq12_sqr_pallas(a)
-    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
-    la = jnp.stack([fq6_add(a0, a1), a0], axis=-4)
-    lb = jnp.stack([fq6_add(a0, fq6_mul_by_v(a1)), a1], axis=-4)
-    t = fq6_mul(la, lb)
-    t01, t0a1 = t[..., 0, :, :, :], t[..., 1, :, :, :]
+    la_ = Z.wrap(a)
+    a0, a1 = _lz_w(la_, 0), _lz_w(la_, 1)
+    la = Z.stack([Z.add(a0, a1), a0], axis=-4)
+    lb = Z.stack([Z.add(a0, _fq6_v_lz(a1)), a1], axis=-4)
+    t = _fq6_mul_lz(la, lb)
+    t01, t0a1 = _lz_w(t, 0), _lz_w(t, 1)
     # t01 = a0^2 + a0*a1*(1+v) + v*a1^2 ; c0 = a0^2 + v a1^2
-    c0 = fq6_sub(fq6_sub(t01, t0a1), fq6_mul_by_v(t0a1))
-    c1 = fq6_add(t0a1, t0a1)
-    return jnp.stack([c0, c1], axis=-4)
+    c0 = Z.sub(Z.sub(t01, t0a1), _fq6_v_lz(t0a1))
+    c1 = Z.mul_small(t0a1, 2)
+    return Z.canon(Z.stack([c0, c1], axis=-4))
+
+
+@jax.jit
+def fq12_cyclotomic_sqr(a):
+    """Granger-Scott squaring for UNITARY f (the cyclotomic subgroup —
+    everything after the final exponentiation's easy part): 9 Fq2
+    squarings in ONE stacked Montgomery call instead of a full Fq12
+    square's 18 Fq2-multiply schedule.  Validated against the pure
+    golden model on easy-part outputs (f^(p^6-1)(p^2+1)).
+
+    Reference analog: blst's fp12 cyclotomic sqr used throughout its
+    final-exp pow-x chains [U, SURVEY.md §2 L0]."""
+    w = Z.wrap(a)
+
+    def c(h, k):
+        return Z.index(w, (Ellipsis, h, k, slice(None), slice(None)))
+
+    c00, c01, c02 = c(0, 0), c(0, 1), c(0, 2)
+    c10, c11, c12 = c(1, 0), c(1, 1), c(1, 2)
+    s = Z.stack([c11, c00, Z.add(c11, c00),
+                 c02, c10, Z.add(c02, c10),
+                 c12, c01, Z.add(c12, c01)], axis=-3)
+    t = Z.canon2p(_fq2_sqr_lz(s))
+    tt = [Z.index(t, (Ellipsis, i, slice(None), slice(None)))
+          for i in range(9)]
+    t0, t1 = tt[0], tt[1]
+    t6 = Z.sub(Z.sub(tt[2], t0), t1)              # 2*c11*c00
+    t2, t3 = tt[3], tt[4]
+    t7 = Z.sub(Z.sub(tt[5], t2), t3)              # 2*c02*c10
+    t4, t5 = tt[6], tt[7]
+    t8 = _fq2_xi_lz(Z.sub(Z.sub(tt[8], t4), t5))  # 2*c12*c01*xi
+    u0 = Z.add(_fq2_xi_lz(t0), t1)                # xi*c11^2 + c00^2
+    u2 = Z.add(_fq2_xi_lz(t2), t3)
+    u4 = Z.add(_fq2_xi_lz(t4), t5)
+    z00 = Z.add(Z.mul_small(Z.sub(u0, c00), 2), u0)
+    z01 = Z.add(Z.mul_small(Z.sub(u2, c01), 2), u2)
+    z02 = Z.add(Z.mul_small(Z.sub(u4, c02), 2), u4)
+    z10 = Z.add(Z.mul_small(Z.add(t8, c10), 2), t8)
+    z11 = Z.add(Z.mul_small(Z.add(t6, c11), 2), t6)
+    z12 = Z.add(Z.mul_small(Z.add(t7, c12), 2), t7)
+    out = Z.stack([Z.stack([z00, z01, z02], axis=-3),
+                   Z.stack([z10, z11, z12], axis=-3)], axis=-4)
+    return Z.canon(out)
 
 
 @jax.jit

@@ -171,12 +171,31 @@ def slot_verify_device(pk_jac, sig_jac, h_jac, r_bits):
     return _pairing_check(p_x, p_y, qx, qy, mask)
 
 
+_SHARDED_CACHE: dict = {}
+
+
 def sharded_slot_verify(mesh, pk_jac, sig_jac, h_jac, r_bits):
     """Multi-chip slot verification: committees sharded over the mesh's
     'sig' axis; each device aggregates its committees' pubkeys, applies
     the RLC, and runs its Miller loops; partial Fq12 products and the
     partial [r]sig sums combine across devices (all-gather over ICI),
-    with one replicated final exponentiation."""
+    with one replicated final exponentiation.
+
+    The WHOLE pipeline (shard_map + cross-device combine) compiles as
+    ONE jit graph, cached per mesh: the combine stage ran eagerly
+    before, and on the redundant-form formulas (lazy.py) eager
+    execution dispatches one tiny XLA compile per tensor op — tens of
+    thousands of sub-second compiles that dominated the multichip
+    dryrun's wall clock."""
+    key = mesh
+    if key not in _SHARDED_CACHE:
+        _SHARDED_CACHE[key] = jax.jit(
+            lambda pk, sig, h, rb: _sharded_slot_verify_traced(
+                mesh, pk, sig, h, rb))
+    return _SHARDED_CACHE[key](pk_jac, sig_jac, h_jac, r_bits)
+
+
+def _sharded_slot_verify_traced(mesh, pk_jac, sig_jac, h_jac, r_bits):
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as Pspec
 

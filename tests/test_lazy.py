@@ -1,0 +1,136 @@
+"""Property tests for the redundant-form (lazy) field domain.
+
+Every op is checked against exact Python integer arithmetic mod P:
+the LZ residue must track the integer residue through add/sub/neg/
+mul_small chains, canon must produce the unique representative, and
+mul must equal the Montgomery product.  Bound bookkeeping is exercised
+at the domain edges (values just below the tracked hi, limbs at lmax).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from prysm_tpu.crypto.bls.params import P
+from prysm_tpu.crypto.bls.xla import lazy as Z
+from prysm_tpu.crypto.bls.xla import limbs as L
+
+R = 1 << L.NBITS
+R_INV = pow(R, -1, P)
+
+
+def _to_int(arr):
+    a = np.asarray(arr, dtype=np.uint64).reshape(-1, arr.shape[-1])
+    return [int(sum(int(v) << (16 * i) for i, v in enumerate(row)))
+            for row in a]
+
+
+def _rand(seed, n=4):
+    rng = np.random.default_rng(seed)
+    vals = [int(rng.integers(0, 1 << 62)) * P // (1 << 62) + i
+            for i in range(n)]
+    vals = [v % P for v in vals]
+    arr = np.stack([np.asarray(L.int_to_limbs_np(v)) for v in vals])
+    return Z.wrap(jnp.asarray(arr)), vals
+
+
+def test_add_sub_neg_chain_matches_ints():
+    a, av = _rand(1)
+    b, bv = _rand(2)
+    c, cv = _rand(3)
+    out = Z.sub(Z.add(a, b), Z.mul_small(c, 3))
+    out = Z.sub(out, Z.neg(b))
+    want = [(x + y - 3 * z + y) % P for x, y, z in zip(av, bv, cv)]
+    got = _to_int(Z.canon(out))
+    assert got == want
+
+
+def test_canon_unique_and_exact_zero():
+    a, av = _rand(4)
+    z = Z.sub(a, a)                       # residue zero, limbs nonzero
+    assert z.hi > 0
+    arr = np.asarray(Z.canon(z))
+    assert not arr.any(), "residue zero must canon to EXACT zero limbs"
+    assert bool(np.all(np.asarray(Z.is_zero_mod(z))))
+    got = _to_int(Z.canon(a))
+    assert got == av
+
+
+def test_canon2p_bound_and_residue():
+    a, av = _rand(5)
+    b, bv = _rand(6)
+    acc = a
+    want = list(av)
+    for i in range(7):                    # long chain grows hi past 60
+        acc = Z.sub(acc, b)
+        want = [(x - y) % P for x, y in zip(want, bv)]
+    c = Z.canon2p(acc)
+    assert c.lmax <= (1 << 16) - 1 and c.hi <= 2.0
+    ints = _to_int(c.arr)
+    assert all(v < 2 * P for v in ints)
+    assert [v % P for v in ints] == want
+
+
+def test_mul_matches_montgomery_product():
+    a, av = _rand(7)
+    b, bv = _rand(8)
+    out = Z.mul(a, b)
+    got = [v % P for v in _to_int(Z.canon(out))]
+    want = [(x * y * R_INV) % P for x, y in zip(av, bv)]
+    assert got == want
+
+
+def test_mul_of_lazy_operands():
+    a, av = _rand(9)
+    b, bv = _rand(10)
+    c, cv = _rand(11)
+    x = Z.sub(a, b)                       # lazy, needs operand norm
+    y = Z.add(b, c)
+    out = Z.mul(x, y)
+    got = [v % P for v in _to_int(Z.canon(out))]
+    want = [((p - q) * (q + r) * R_INV) % P
+            for p, q, r in zip(av, bv, cv)]
+    assert got == want
+
+
+def test_mul_exact_zero_times_anything():
+    a, av = _rand(12)
+    z = Z.wrap(jnp.zeros_like(a.arr))
+    out = Z.mul(z, a)
+    assert [v % P for v in _to_int(Z.canon(out))] == [0] * len(av)
+
+
+def test_barrett_edge_near_multiples_of_p():
+    # values k*P + eps for k across the table range: the quotient
+    # estimate must stay exact (off-by-one absorbed by the csub)
+    for k in (0, 1, 2, 3, 8, 9, 17, 18):
+        for eps in (0, 1, P - 1):
+            v = k * P + eps
+            hi = v // P + 1               # hi is a STRICT bound
+            # redundant rep: sum of canonical chunks (limbs stack up)
+            chunks = []
+            rem = v
+            cap = (1 << L.NBITS) - 1
+            while rem:
+                take = min(rem, cap)
+                chunks.append(np.asarray(L.int_to_limbs_np(take),
+                                         np.uint32))
+                rem -= take
+            arr = (np.sum(np.stack(chunks), axis=0, dtype=np.uint32)
+                   if chunks else np.zeros(L.NLIMBS, np.uint32))
+            arr = arr[None]
+            lz = Z.LZ(jnp.asarray(arr), float(hi),
+                      int(arr.max()) if arr.any() else 0)
+            got = _to_int(Z.canon(lz))[0]
+            assert got == v % P, f"k={k} eps={eps}"
+
+
+def test_select_and_stack():
+    a, av = _rand(13)
+    b, bv = _rand(14)
+    cond = jnp.asarray(np.array([True, False, True, False]))
+    out = Z.select(cond, Z.sub(a, b), Z.add(a, b))
+    want = [(x - y) % P if c else (x + y) % P
+            for x, y, c in zip(av, bv, [True, False, True, False])]
+    assert _to_int(Z.canon(out)) == want

@@ -47,7 +47,7 @@ class TestDeviceVerifyRejections:
     def test_signature_from_wrong_group_message(self, rng):
         # a valid curve point that is NOT [sk]H(m): [sk]G2 generator
         sk, pk = _keypair(3)
-        forged_point = pc.multiply(pc.G2_GEN, sk.k)
+        forged_point = pc.multiply(pc.G2_GEN, sk._k)
         forged = bls.Signature(point=forged_point)
         assert not forged.verify(pk, b"anything")
 
