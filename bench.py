@@ -290,7 +290,8 @@ def bench_htr_state_warm():
 
     use_mainnet_config()
     from prysm_tpu.config import MAINNET_CONFIG
-    from prysm_tpu.proto import FAR_FUTURE_EPOCH, Validator, build_types
+    from prysm_tpu.core.helpers import FAR_FUTURE_EPOCH
+    from prysm_tpu.proto import Validator, build_types
 
     types = build_types(MAINNET_CONFIG)
     n = 500_000

@@ -123,7 +123,8 @@ def point_double(ops: FieldOps, pt):
     lz = _lz_for(ops)
     X, Y, Z = (Zl.wrap(c) for c in pt)
     A, B = lz.mul_many([(X, X), (Y, Y)])
-    C, t = lz.mul_many([(B, B), (Zl.add(X, B), Zl.add(X, B))])
+    XB = Zl.add(X, B)
+    C, t = lz.mul_many([(B, B), (XB, XB)])
     D = Zl.mul_small(Zl.sub(Zl.sub(t, A), C), 2)
     E = Zl.mul_small(A, 3)
     F, YZ = lz.mul_many([(E, E), (Y, Z)])

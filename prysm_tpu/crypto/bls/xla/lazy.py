@@ -48,6 +48,8 @@ arrays exactly as before.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -149,6 +151,24 @@ class LZ:
         return self.lmax <= B - 1
 
 
+# Read ONCE at import: jit graphs traced earlier cannot be
+# invalidated by a mid-process env flip, so a late toggle would
+# silently measure the wrong formulation.
+_FORCE_LAZY_TPU = os.environ.get("PRYSM_LAZY_TPU") == "1"
+
+
+def _legacy() -> bool:
+    """TPU traces use the CANONICAL formulation behind the same LZ
+    interface: the lazy domain exists for graph-size wins (XLA:CPU
+    pays ~25 ms LLVM codegen per op, so compile time scales with op
+    count), but on TPU execution is LATENCY-bound and XLA:TPU fuses
+    the canonical elementwise carry chains well — A/B on the v5e
+    chip showed the lazy glue (Barrett one-hot tables, spread adds)
+    costs more wall time per slot than it saves.  Decided at trace
+    time, like the fp_mul kernel routing."""
+    return jax.default_backend() == "tpu" and not _FORCE_LAZY_TPU
+
+
 def wrap(arr_u32, hi: float = 2.0) -> LZ:
     """Canonical uint32 limbs -> LZ (free)."""
     return LZ(arr_u32, hi, B - 1)
@@ -165,23 +185,31 @@ def _add_arr(x, y):
 
 
 def add(a: LZ, b: LZ) -> LZ:
+    if _legacy():
+        return LZ(L.fp_add(a.arr, b.arr), 2.0, B - 1)
     return LZ(_add_arr(a.arr, b.arr), a.hi + b.hi, a.lmax + b.lmax)
 
 
 def sub(a: LZ, b: LZ) -> LZ:
     """a - b + k*P with k*P the spread constant covering b's limbs."""
+    if _legacy():
+        return LZ(L.fp_sub(a.arr, b.arr), 2.0, B - 1)
     s_arr, s_k, s_lmax = _spread(b.lmax + 1)
     return LZ(_add_arr(a.arr, s_arr - b.arr), a.hi + float(s_k),
               a.lmax + s_lmax)
 
 
 def neg(a: LZ) -> LZ:
+    if _legacy():
+        return LZ(L.fp_neg(a.arr), 2.0, B - 1)
     s_arr, s_k, s_lmax = _spread(a.lmax + 1)
     return LZ(s_arr - a.arr, float(s_k), s_lmax)
 
 
 def mul_small(a: LZ, k: int) -> LZ:
     assert k >= 0
+    if _legacy():
+        return LZ(L.fp_mul_small(a.arr, k), 2.0, B - 1)
     return LZ(a.arr * jnp.uint32(k), a.hi * k, a.lmax * k)
 
 
@@ -237,7 +265,8 @@ def _barrett(v, hi: float):
 
 
 def canon2p(a: LZ) -> LZ:
-    """Any LZ -> canonical 16-bit limbs, value < 2P, same residue."""
+    """Any LZ -> canonical 16-bit limbs, value < 2P, same residue.
+    Identity in legacy (TPU) mode — every value is already canonical."""
     if a.canonical16 and a.hi <= 2.0:
         return a
     if a._norm is not None:
@@ -261,7 +290,13 @@ def canon2p(a: LZ) -> LZ:
 
 def canon(a: LZ):
     """LZ -> the unique canonical representative in [0, P), uint32.
-    Residue zero comes out as EXACT zero limbs."""
+    Residue zero comes out as EXACT zero limbs.
+
+    Legacy (TPU) mode: values are < 2P with exact-zero propagation
+    (the pre-lazy contract every formula was proven under on
+    hardware), so the boundary pass is the identity."""
+    if _legacy() and a.canonical16 and a.hi <= 2.0:
+        return a.arr
     c = canon2p(a)
     d, borrow = L._sub_borrow(c.arr, jnp.asarray(L.P_LIMBS))
     return jnp.where((borrow == 0)[..., None], d, c.arr)
@@ -289,7 +324,7 @@ def mul(a: LZ, b: LZ) -> LZ:
     XLA core: value < (0.102*4 + 1)*P < 1.41P; TPU kernel: < P."""
     a = norm_operand(a)
     b = norm_operand(b)
-    if jax.default_backend() == "tpu" or L.get_mul_backend() == "pallas":
+    if L.use_mosaic_mul():
         from .pallas_mont import mont_mul_pallas
 
         return LZ(mont_mul_pallas(a.arr, b.arr), 1.0, B - 1)

@@ -343,21 +343,30 @@ def get_mul_backend() -> str:
     return _MUL_BACKEND
 
 
+def use_mosaic_mul() -> bool:
+    """THE routing predicate for Montgomery multiplies (trace time).
+
+    On TPU multiplies ALWAYS route through the Mosaic kernel,
+    regardless of the backend flag: XLA:TPU miscompiles large fused
+    uint32 programs (verified 2026-07-31 — every limb op is bit-exact
+    standalone at any rank/batch, but composed towers silently corrupt
+    most coefficients once the fused program passes a size threshold;
+    slot-verify returned False for valid slots).  The kernel is
+    bit-exact AND each launch bounds XLA's fusion regions to the small
+    shapes that are proven exact.  Shared by fp_mul, the fq12 kernel
+    routing (tower.py) and lazy.mul so the miscompile-critical
+    decision lives in exactly one place."""
+    return _MUL_BACKEND == "pallas" or jax.default_backend() == "tpu"
+
+
 @jax.jit
 def fp_mul(a, b):
     """Montgomery product mont(a) * mont(b) -> mont(a*b).
 
-    On TPU this ALWAYS routes through the Mosaic kernel, regardless of
-    the backend flag: XLA:TPU miscompiles large fused uint32 programs
-    (verified 2026-07-31 — every limb op is bit-exact standalone at
-    any rank/batch, but composed towers silently corrupt most
-    coefficients once the fused program passes a size threshold;
-    slot-verify returned False for valid slots).  The kernel is
-    bit-exact AND each launch bounds XLA's fusion regions to the
-    small shapes that are proven exact.  The plain XLA formulation
+    TPU routing: see use_mosaic_mul().  The plain XLA formulation
     remains the CPU path (exact there, and interpret-mode kernels
     would be unusably slow)."""
-    if _MUL_BACKEND == "pallas" or jax.default_backend() == "tpu":
+    if use_mosaic_mul():
         from .pallas_mont import mont_mul_pallas
 
         return mont_mul_pallas(a, b)

@@ -50,7 +50,7 @@ from ..params import BLS_X_ABS, P, R
 from . import lazy as Zl
 from . import limbs as L
 from . import tower as T
-from .curve import FQ2_OPS, point_double
+from .curve import FQ2_OPS, _mul_many, point_double
 
 # bits of |x| after the leading 1, MSB-first (static Python constants)
 X_BITS = [int(b) for b in bin(BLS_X_ABS)[3:]]
@@ -76,12 +76,8 @@ def _fp_pair(s: "Zl.LZ") -> "Zl.LZ":
 
 def _mul_many_fq2(pairs):
     """Independent Fq2 multiplies of one step stage as ONE stacked
-    Karatsuba/Montgomery call (see curve._mul_many)."""
-    la = Zl.stack([a for a, _ in pairs], axis=-3)
-    lb = Zl.stack([b for _, b in pairs], axis=-3)
-    t = T._fq2_mul_lz(la, lb)
-    return tuple(Zl.index(t, (Ellipsis, i, slice(None), slice(None)))
-                 for i in range(len(pairs)))
+    Karatsuba/Montgomery call (shared impl: curve._mul_many)."""
+    return _mul_many(T._fq2_mul_lz, 2, pairs)
 
 
 def _dbl_step(t, xp, yp):

@@ -334,10 +334,9 @@ def fq12_mul(a, b):
     total in a single batched Montgomery multiply).  The pallas
     backend routes to the FUSED lazy-reduction kernel instead (one
     launch, 12 Montgomery reductions — pallas_tower.py)."""
-    if L.get_mul_backend() == "pallas" or jax.default_backend() == "tpu":
+    if L.use_mosaic_mul():
         # TPU: the fused kernel is both the fast path and the
-        # correctness path (see limbs.fp_mul on the XLA:TPU fusion
-        # miscompile)
+        # correctness path (see limbs.use_mosaic_mul)
         from .pallas_tower import fq12_mul_pallas
 
         return fq12_mul_pallas(a, b)
@@ -367,7 +366,7 @@ def _fq12_mul_lz(a: Z.LZ, b: Z.LZ) -> Z.LZ:
 def fq12_sqr(a):
     """Complex-style squaring: 2 Fq6 muls in one stacked call (pallas
     backend: one fused kernel launch)."""
-    if L.get_mul_backend() == "pallas" or jax.default_backend() == "tpu":
+    if L.use_mosaic_mul():
         from .pallas_tower import fq12_sqr_pallas
 
         return fq12_sqr_pallas(a)
