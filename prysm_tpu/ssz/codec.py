@@ -402,13 +402,92 @@ def _bytes_to_bits(data: bytes, nbits: int) -> list[bool]:
 # --- containers ------------------------------------------------------------
 
 
+import weakref
+
+# Instances whose cached root was invalidated by a field write since
+# they were last placed into a leaf row — the dirty-field state cache
+# (state/htr_cache.py) drains this to patch O(changed) rows instead of
+# looping 500k validators per root.  Keyed by id() (containers define
+# __eq__ without __hash__); weak VALUES so an instance dying with its
+# state does not pin memory.  Only mutations AFTER the first hash land
+# here (construction-time setattrs have no _iroot yet).
+DIRTY_MEMO_LOG: "weakref.WeakValueDictionary" = \
+    weakref.WeakValueDictionary()
+
+
 def _invalidating_setattr(self, name, value):
     """__setattr__ for root_memo containers: any field write drops the
-    instance's cached hash tree root."""
+    instance's cached hash tree root (and logs the instance for the
+    state cache's incremental row patching)."""
     d = self.__dict__
     d[name] = value
     if "_iroot" in d and name != "_iroot":
         del d["_iroot"]
+        DIRTY_MEMO_LOG[id(self)] = self
+
+
+class TrackedList(list):
+    """List that records which indices were mutated (the state HTR
+    cache patches exactly those leaf rows).  Mutators that change
+    structure beyond append/set mark the whole list dirty — the cache
+    then falls back to its full numpy diff, so tracking can only ever
+    make things faster, never wrong."""
+
+    __slots__ = ("dirty", "full_dirty")
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.dirty = set()
+        self.full_dirty = False
+
+    # append/extend need no override: growth is detected by comparing
+    # the list length against the trie's synced length
+
+    def __setitem__(self, i, v):
+        if isinstance(i, slice):
+            self.full_dirty = True
+        else:
+            self.dirty.add(i if i >= 0 else len(self) + i)
+        super().__setitem__(i, v)
+
+    def __delitem__(self, i):
+        self.full_dirty = True
+        super().__delitem__(i)
+
+    def insert(self, i, v):
+        self.full_dirty = True
+        super().insert(i, v)
+
+    def pop(self, i=-1):
+        self.full_dirty = True
+        return super().pop(i)
+
+    def remove(self, v):
+        self.full_dirty = True
+        super().remove(v)
+
+    def clear(self):
+        self.full_dirty = True
+        super().clear()
+
+    def sort(self, **kw):
+        self.full_dirty = True
+        super().sort(**kw)
+
+    def reverse(self):
+        self.full_dirty = True
+        super().reverse()
+
+    def __iadd__(self, it):
+        self.extend(it)
+        return self
+
+    def drain(self):
+        """(dirty_indices, full_dirty) since last drain; resets."""
+        d, f = self.dirty, self.full_dirty
+        self.dirty = set()
+        self.full_dirty = False
+        return d, f
 
 
 class Container(SSZType):
