@@ -419,8 +419,12 @@ def main() -> None:
                           "vs_baseline": 0}), flush=True)
         return
     # 2) the full sweep (VERDICT r2 #4): every BASELINE config,
-    # recorded to BENCH_FULL.json; skip with PRYSM_BENCH_FULL=0
-    if os.environ.get("PRYSM_BENCH_FULL", "1") == "0":
+    # recorded to BENCH_FULL.json.  OPT-IN (PRYSM_BENCH_FULL=1): the
+    # driver's end-of-round `python bench.py` has a finite wall budget
+    # and the sweep blew it in round 3 (rc=124 with the metric line
+    # already printed); the sweep is run by hand each round instead and
+    # its BENCH_FULL.json committed.
+    if os.environ.get("PRYSM_BENCH_FULL", "0") != "1":
         return
     for name in FULL_TIERS:
         if name in results:

@@ -249,7 +249,13 @@ def _barrett(v, hi: float):
         _QP_CACHE[qmax] = _qp_table(qmax)         # numpy: see _spread
     table = jnp.asarray(_QP_CACHE[qmax])          # (qmax+1, 25)
     t = (v[..., 23] >> 8) | (v[..., 24] << 8)     # bits 376..400
-    q_hat = (t * jnp.uint32(BARRETT_K)) >> 16
+    # clamp to qmax: a no-op while the bound analysis above holds
+    # (q_hat <= qmax by construction), but if a bound-tracking bug
+    # ever produced q_hat > qmax the one-hot select below would
+    # silently pick qp=0 and return an UNREDUCED value — clamping
+    # keeps the subtraction sound instead (ADVICE r3)
+    q_hat = jnp.minimum((t * jnp.uint32(BARRETT_K)) >> 16,
+                        jnp.uint32(qmax))
     oh_shape = (qmax + 1,) + (1,) * v.ndim
     qvals = jnp.arange(qmax + 1, dtype=jnp.uint32).reshape(oh_shape)
     onehot = (q_hat[None, ..., None] == qvals).astype(jnp.uint32)

@@ -402,28 +402,28 @@ def _bytes_to_bits(data: bytes, nbits: int) -> list[bool]:
 # --- containers ------------------------------------------------------------
 
 
-import weakref
-
-# Instances whose cached root was invalidated by a field write since
-# they were last placed into a leaf row — the dirty-field state cache
-# (state/htr_cache.py) drains this to patch O(changed) rows instead of
-# looping 500k validators per root.  Keyed by id() (containers define
-# __eq__ without __hash__); weak VALUES so an instance dying with its
-# state does not pin memory.  Only mutations AFTER the first hash land
-# here (construction-time setattrs have no _iroot yet).
-DIRTY_MEMO_LOG: "weakref.WeakValueDictionary" = \
-    weakref.WeakValueDictionary()
+import itertools
 
 
 def _invalidating_setattr(self, name, value):
     """__setattr__ for root_memo containers: any field write drops the
-    instance's cached hash tree root (and logs the instance for the
-    state cache's incremental row patching)."""
+    instance's cached hash tree root (and logs the instance into the
+    dirty log of the tracked list that owns it, if any, so the state
+    cache patches O(changed) rows instead of looping 500k validators
+    per root).  The log (``_dlog``) is a WeakValueDictionary owned by
+    the list's cache lineage — scoping it per list (ADVICE r3) keeps a
+    root from scanning every live mutated container process-wide.
+    Only mutations AFTER the first hash land here (construction-time
+    setattrs have no _iroot yet)."""
     d = self.__dict__
     d[name] = value
     if "_iroot" in d and name != "_iroot":
         del d["_iroot"]
-        DIRTY_MEMO_LOG[id(self)] = self
+        log = d.get("_dlog")
+        if log is not None:
+            # keyed by id() (containers define __eq__ without
+            # __hash__); weak VALUES so a dying instance is dropped
+            log[id(self)] = self
 
 
 class TrackedList(list):
@@ -433,12 +433,17 @@ class TrackedList(list):
     then falls back to its full numpy diff, so tracking can only ever
     make things faster, never wrong."""
 
-    __slots__ = ("dirty", "full_dirty")
+    __slots__ = ("dirty", "full_dirty", "uid")
+
+    _next_uid = itertools.count(1)
 
     def __init__(self, *args):
         super().__init__(*args)
         self.dirty = set()
         self.full_dirty = False
+        # stable lineage key for the state HTR cache (id() values are
+        # reused after gc; uids never are)
+        self.uid = next(TrackedList._next_uid)
 
     # append/extend need no override: growth is detected by comparing
     # the list length against the trie's synced length
@@ -621,8 +626,14 @@ class Container(SSZType):
         for name, typ in type(self).fields:
             v = getattr(self, name)
             if isinstance(v, list):
-                v = [x.copy() if isinstance(x, Container) else
-                     (list(x) if isinstance(x, list) else x) for x in v]
+                elems = [x.copy() if isinstance(x, Container) else
+                         (list(x) if isinstance(x, list) else x)
+                         for x in v]
+                # preserve TrackedList (fresh tracking state, own uid)
+                # so a copied state's roots stay on the incremental
+                # HTR-cache path instead of full rebuilds (ADVICE r3)
+                v = (TrackedList(elems) if isinstance(v, TrackedList)
+                     else elems)
             elif isinstance(v, Container):
                 v = v.copy()
             setattr(new, name, v)

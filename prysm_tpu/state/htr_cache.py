@@ -22,21 +22,24 @@ Heavy fields and their trie shapes:
 
 Everything else re-merkleizes through the codec each call — those
 fields are a few dozen chunks.  One cache instance serves each
-BeaconState class; consecutive roots of an advancing chain diff in
-O(changed), and a replay jumping to an older state is just a bigger
-diff.  Disable with PRYSM_STATE_HTR_CACHE=0 (tests differentially
-compare both paths)."""
+BeaconState class.  List fields keep one incremental trie per
+*lineage* (per TrackedList uid, bounded LRU) so head + fork states
+each stay O(changed) — ``Container.copy`` preserves TrackedList, so a
+fork-choice workflow rooting two diverged states never ping-pongs
+into full rebuilds (ADVICE r3).  Disable with PRYSM_STATE_HTR_CACHE=0
+(tests differentially compare both paths)."""
 
 from __future__ import annotations
 
 import os
 import threading
+import weakref
+from collections import OrderedDict
 
 import numpy as np
 
 from ..ssz.codec import (
-    DIRTY_MEMO_LOG, TrackedList, ZERO_HASHES, merkleize_chunks,
-    mix_in_length,
+    TrackedList, ZERO_HASHES, merkleize_chunks, mix_in_length,
 )
 from .fieldtrie import FieldTrie
 
@@ -48,6 +51,9 @@ _LIST_DEPTH = {
 }
 _VECTOR_FIELDS = ("block_roots", "state_roots", "randao_mixes",
                   "slashings")
+# tracked lineages kept per list field (head + fork + scratch); each
+# validators trie at 500k is ~32 MB, so the cap bounds memory
+_MAX_LINEAGES = int(os.environ.get("PRYSM_HTR_LINEAGES", "3"))
 
 
 def _pack_u64(values) -> np.ndarray:
@@ -61,15 +67,10 @@ def _pack_u64(values) -> np.ndarray:
 
 
 def _leaf_array(name: str, typ, value) -> np.ndarray:
-    """(n_chunks, 32) uint8 leaf chunks for a heavy field."""
-    if name == "validators":
-        vt = typ.elem
-        htr = vt.hash_tree_root
-        out = np.empty((len(value), 32), dtype=np.uint8)
-        for i, v in enumerate(value):
-            out[i] = np.frombuffer(htr(v), dtype=np.uint8)
-        return out
-    if name in ("balances", "slashings"):
+    """(n_chunks, 32) uint8 leaf chunks for a VECTOR field (list-field
+    leaf building lives in StateHTRCache._full_resync, which also does
+    ownership tagging)."""
+    if name == "slashings":
         return _pack_u64(value)
     # Bytes32 vectors
     if not value:
@@ -81,14 +82,52 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+# id()s of every live lineage's dlog, across all caches — lets a full
+# resync distinguish "owned by a live foreign lineage" (must not steal
+# the tags) from "tagged by a dead, LRU-evicted lineage" (safe to
+# reclaim: the full diff is authoritative at that point, and nobody is
+# reading the dead log)
+_LIVE_DLOGS: set[int] = set()
+
+
+class _Lineage:
+    """Per-(field, TrackedList-uid) incremental trie + its dirty log."""
+
+    __slots__ = ("trie", "elem_len", "dlog", "aliased")
+
+    def __init__(self):
+        self.trie: FieldTrie | None = None
+        self.elem_len = 0
+        # containers whose cached root was invalidated since they were
+        # last written into a leaf row of THIS lineage — filled by
+        # codec._invalidating_setattr via the instances' _dlog ref
+        self.dlog: "weakref.WeakValueDictionary" = \
+            weakref.WeakValueDictionary()
+        _LIVE_DLOGS.add(id(self.dlog))
+        # True once the same container instance was seen at two rows —
+        # the _vidx hint can then only patch one of them, so the
+        # incremental path is disabled for this lineage (ADVICE r3)
+        self.aliased = False
+
+    def retire(self) -> None:
+        _LIVE_DLOGS.discard(id(self.dlog))
+
+    def mark_aliased(self) -> None:
+        """Permanently downgrade to the full-diff path.  Also retires
+        the dlog: an aliased lineage derives nothing from owning
+        instances, and keeping its tags live would contagiously
+        downgrade any other lineage that later contains one of them."""
+        self.aliased = True
+        self.retire()
+
+
 class StateHTRCache:
     """Per-BeaconState-class diff-based root cache."""
 
     def __init__(self, cls):
         self.cls = cls
-        self._tries: dict[str, FieldTrie] = {}
-        self._list_ids: dict[str, int] = {}
-        self._elem_len: dict[str, int] = {}
+        self._tries: dict[str, FieldTrie] = {}        # vector fields
+        self._lineages: dict[str, OrderedDict[int, _Lineage]] = {}
         self._lock = threading.Lock()
 
     def root(self, state) -> bytes:
@@ -107,16 +146,14 @@ class StateHTRCache:
 
     # --- field paths -------------------------------------------------------
 
-    def _sync_trie(self, name: str, leaves: np.ndarray) -> FieldTrie:
-        """Bring the field's trie to the current leaf array: rebuild on
+    def _sync_trie_diff(self, trie: FieldTrie | None,
+                        leaves: np.ndarray) -> FieldTrie:
+        """Bring a trie to the current leaf array: rebuild on
         shrink/overflow, append growth, then re-hash only the leaves
         whose bytes changed."""
         n = leaves.shape[0]
-        trie = self._tries.get(name)
         if trie is None or n < trie.length or n > trie.limit:
-            trie = FieldTrie.from_array(leaves, _next_pow2(n))
-            self._tries[name] = trie
-            return trie
+            return FieldTrie.from_array(leaves, _next_pow2(n))
         if n > trie.length:
             for i in range(trie.length, n):
                 trie.append(leaves[i].tobytes())
@@ -131,13 +168,26 @@ class StateHTRCache:
     #
     # Rebuilding the full leaf array costs an O(n) Python loop — ~750ms
     # at 500k validators even with every per-validator root memoized.
-    # When the SAME TrackedList instance is rooted again, the mutation
-    # record (list-level: TrackedList.dirty; element-level: the
-    # DIRTY_MEMO_LOG of root_memo containers whose fields were written,
-    # located via their _vidx row hints) gives the exact dirty rows, so
-    # the sync is O(changed * log n).  Any uncertainty — identity
-    # mismatch, slice/structural mutation, a foreign list — falls back
-    # to the full diff, so tracking can only speed up, never corrupt.
+    # When a TrackedList instance is rooted again against its lineage,
+    # the mutation record (list-level: TrackedList.dirty; element-
+    # level: the lineage's dlog of root_memo containers whose fields
+    # were written, located via their _vidx row hints) gives the exact
+    # dirty rows, so the sync is O(changed * log n).  Any uncertainty —
+    # unknown lineage, slice/structural mutation, detected row
+    # aliasing — falls back to the full diff, so tracking can only
+    # speed up, never corrupt.
+    #
+    # Ownership model: the FIRST lineage to tag an instance
+    # (_vidx/_dlog) owns it; other lineages never steal the tags.  A
+    # lineage that encounters a foreign-owned instance (cross-list
+    # sharing — only possible when user code moves a container between
+    # states without .copy()) is permanently downgraded to the
+    # full-diff path, while the owner's hints stay intact and correct.
+    # Intra-list aliasing (the same instance at two rows) is detected
+    # at full rebuild by an id scan and at patch time by a seen-id set
+    # over the rows being written plus a _vidx cross-check, and
+    # likewise downgrades the lineage.  Either way hint-based patching
+    # is only ever used when every hint is unambiguous.
 
     def _n_rows(self, name: str, value) -> int:
         """Trie rows for a list field: one per validator, or one per
@@ -148,32 +198,19 @@ class StateHTRCache:
 
     def _row_bytes(self, name, typ, value, row: int) -> bytes:
         if name == "validators":
-            v = value[row]
-            v.__dict__["_vidx"] = row
-            return typ.elem.hash_tree_root(v)
+            return typ.elem.hash_tree_root(value[row])
         chunk = np.zeros(4, dtype="<u8")
         vals = value[4 * row:4 * row + 4]
         chunk[:len(vals)] = vals
         return chunk.view(np.uint8).tobytes()
 
-    def _incremental_list_sync(self, name, typ, value):
+    def _incremental_list_sync(self, name, typ, value,
+                               entry: _Lineage):
         """Returns the synced trie, or None when the fast path does
-        not apply (caller falls back to the full numpy diff).
-
-        Sound because (a) the fast path only ever serves the single
-        most-recently-built list per field (identity-checked), every
-        other list full-rebuilds; (b) list-level mutations come from
-        TrackedList's record; (c) element-level mutations come from
-        the DIRTY_MEMO_LOG, matched into rows by their _vidx hint and
-        consumed only when the hint verifies against THIS list.  The
-        one unsupported pattern — the same mutable container instance
-        living in two concurrently-rooted tracked lists — does not
-        occur: states deep-copy their validators (ssz Container.copy)."""
-        trie = self._tries.get(name)
+        not apply (caller falls back to the full numpy diff)."""
+        trie = entry.trie
         n_rows = self._n_rows(name, value)
-        if (not isinstance(value, TrackedList)
-                or self._list_ids.get(name) != id(value)
-                or trie is None or n_rows < trie.length
+        if (entry.aliased or trie is None or n_rows < trie.length
                 or n_rows > trie.limit):
             return None
         dirty_elems, full = value.drain()
@@ -181,43 +218,144 @@ class StateHTRCache:
             return None
         if name == "validators":
             dirty_rows = {i for i in dirty_elems if i < len(value)}
-            # element-level mutations: logged instances in THIS list
-            for key, inst in list(DIRTY_MEMO_LOG.items()):
+            # element-level mutations: instances logged against THIS
+            # lineage whose row hint still verifies.  (A non-verifying
+            # hint means the instance was replaced out of its row —
+            # that row is in TrackedList.dirty — because only the
+            # owning lineage ever tags, so hints cannot silently point
+            # at a different list's rows.)
+            log = entry.dlog
+            while True:
+                try:
+                    _, inst = log.popitem()
+                except KeyError:
+                    break
                 i = inst.__dict__.get("_vidx")
                 if (i is not None and i < len(value)
                         and value[i] is inst):
                     dirty_rows.add(i)
-                    DIRTY_MEMO_LOG.pop(key, None)
+            # pre-pass over every row about to be (re)written: tag
+            # newly-placed instances, and flag the patterns hint-based
+            # patching cannot represent — the same instance placed at
+            # two of these rows (seen-id set), an instance whose
+            # recorded row differs but still matches the list there
+            # (alias with a previously-synced row), or an instance
+            # owned by another lineage's dirty log (cross-list
+            # sharing).  Any hit downgrades the lineage for good.
+            seen: set[int] = set()
+            dlog = entry.dlog
+            # union, not concatenation: a setitem on a just-appended
+            # index lands in both dirty_rows and the growth range, and
+            # visiting it twice would false-positive the seen-id check
+            for row in dirty_rows | set(range(trie.length, n_rows)):
+                v = value[row]
+                d = v.__dict__
+                if id(v) in seen:
+                    entry.mark_aliased()
+                    return None
+                seen.add(id(v))
+                cur = d.get("_dlog")
+                if (cur is not None and cur is not dlog
+                        and id(cur) in _LIVE_DLOGS):
+                    # owned by a LIVE foreign lineage; a dead tag
+                    # (evicted or aliased owner) is reclaimed below
+                    entry.mark_aliased()
+                    return None
+                prev = d.get("_vidx")
+                if (prev is not None and prev != row
+                        and prev < len(value) and value[prev] is v):
+                    entry.mark_aliased()
+                    return None
+                d["_vidx"] = row
+                d["_dlog"] = dlog
         else:
             dirty_rows = {i // 4 for i in dirty_elems}
-            if self._elem_len.get(name, 0) != len(value):
+            if entry.elem_len != len(value):
                 # growth can land inside the last previously-synced
                 # packed chunk: re-pack the boundary row
-                dirty_rows.add(self._elem_len.get(name, 0) // 4)
-        for row in range(trie.length, n_rows):
+                dirty_rows.add(entry.elem_len // 4)
+        start = trie.length
+        for row in range(start, n_rows):
             trie.append(self._row_bytes(name, typ, value, row))
+        # rows in the growth range were just written with current
+        # bytes — re-hashing them via update_batch would walk their
+        # Merkle paths a second time for nothing
         updates = {int(r): self._row_bytes(name, typ, value, r)
-                   for r in dirty_rows if r < n_rows}
+                   for r in dirty_rows if r < start}
         if updates:
             trie.update_batch(updates)
-        self._elem_len[name] = len(value)
+        entry.elem_len = len(value)
         return trie
 
-    def _list_root(self, name: str, typ, value, state) -> bytes:
-        trie = self._incremental_list_sync(name, typ, value)
-        if trie is None:
-            leaves = _leaf_array(name, typ, value)
-            if name == "validators":
+    def _full_resync(self, name, typ, value, entry: _Lineage) -> None:
+        """Rebuild the lineage from the current leaf array (numpy diff
+        against any existing trie), tagging every validator this
+        lineage owns with its row hint + the lineage's dirty log.
+        The log object is stable for the lineage's lifetime (cleared,
+        never replaced) so an instance tagged in an earlier resync
+        still compares as owned."""
+        entry.dlog.clear()
+        if name == "validators":
+            htr = typ.elem.hash_tree_root
+            leaves = np.empty((len(value), 32), dtype=np.uint8)
+            if entry.aliased:
+                # hints are never consulted again: plain leaf loop,
+                # no tagging (and no ownership claims that would
+                # downgrade other lineages)
                 for i, v in enumerate(value):
-                    v.__dict__["_vidx"] = i
-            trie = self._sync_trie(name, leaves)
-            if not isinstance(value, TrackedList):
-                value = TrackedList(value)
-                setattr(state, name, value)
+                    leaves[i] = np.frombuffer(htr(v), dtype=np.uint8)
             else:
-                value.drain()
-            self._list_ids[name] = id(value)
-            self._elem_len[name] = len(value)
+                dlog = entry.dlog
+                seen: set[int] = set()
+                aliased = False
+                for i, v in enumerate(value):
+                    d = v.__dict__
+                    cur = d.get("_dlog")
+                    if (cur is None or cur is dlog
+                            or id(cur) not in _LIVE_DLOGS):
+                        # untagged, ours, or orphaned by a dead
+                        # lineage — reclaim (the full diff below is
+                        # authoritative, so stealing a dead tag is
+                        # safe)
+                        d["_vidx"] = i
+                        d["_dlog"] = dlog
+                    else:
+                        # owned by another LIVE lineage (cross-list
+                        # sharing): leave the owner's hints intact —
+                        # it stays incremental and correct; THIS
+                        # lineage keeps full-diffing, needing no hints
+                        aliased = True
+                    if id(v) in seen:
+                        aliased = True
+                    seen.add(id(v))
+                    leaves[i] = np.frombuffer(htr(v), dtype=np.uint8)
+                if aliased:
+                    entry.mark_aliased()
+        else:
+            leaves = _pack_u64(value)
+        entry.trie = self._sync_trie_diff(entry.trie, leaves)
+        value.drain()
+        entry.elem_len = len(value)
+
+    def _list_root(self, name: str, typ, value, state) -> bytes:
+        if not isinstance(value, TrackedList):
+            value = TrackedList(value)
+            setattr(state, name, value)
+        lineages = self._lineages.setdefault(name, OrderedDict())
+        entry = lineages.get(value.uid)
+        if entry is not None:
+            lineages.move_to_end(value.uid)
+            trie = self._incremental_list_sync(name, typ, value, entry)
+            if trie is None:
+                self._full_resync(name, typ, value, entry)
+        else:
+            entry = _Lineage()
+            self._full_resync(name, typ, value, entry)
+            lineages[value.uid] = entry
+            while len(lineages) > _MAX_LINEAGES:
+                _, evicted = lineages.popitem(last=False)
+                evicted.retire()
+        trie = entry.trie
         node = trie.vector_root()
         for level in range(trie.depth, _LIST_DEPTH[name]):
             node = _hash2(node, ZERO_HASHES[level])
@@ -229,7 +367,8 @@ class StateHTRCache:
         if n == 0 or n & (n - 1):
             # non-pow2 chunk count (odd preset): codec fallback
             return typ.hash_tree_root(value)
-        trie = self._sync_trie(name, leaves)
+        trie = self._sync_trie_diff(self._tries.get(name), leaves)
+        self._tries[name] = trie
         return trie.vector_root()
 
 

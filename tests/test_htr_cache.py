@@ -87,6 +87,135 @@ def test_scalar_and_checkpoint_fields(genesis):
     _check(state)
 
 
+def test_copy_preserves_tracked_lists(genesis):
+    # ADVICE r3: Container.copy() must keep list fields TrackedList so
+    # a fork lineage stays on the incremental HTR path
+    from prysm_tpu.ssz.codec import TrackedList
+
+    type(genesis).hash_tree_root(genesis)   # ensures lists are tracked
+    assert isinstance(genesis.validators, TrackedList)
+    c = genesis.copy()
+    assert isinstance(c.validators, TrackedList)
+    assert isinstance(c.balances, TrackedList)
+    assert c.validators.uid != genesis.validators.uid
+
+
+def test_fork_lineages_both_incremental(genesis):
+    # two diverged lineages rooted alternately must BOTH be correct on
+    # every root (each keeps its own trie; no ping-pong full rebuilds)
+    a = genesis.copy()
+    b = a.copy()
+    _check(a)
+    _check(b)
+    entry_a, entry_b = _lineage(a), _lineage(b)
+    assert entry_a is not None and entry_b is not None
+    trie_a, trie_b = entry_a.trie, entry_b.trie
+    assert trie_a is not trie_b
+    for round_ in range(3):
+        a.balances[round_] += 11
+        a.validators[round_].effective_balance -= 1
+        b.balances[-(round_ + 1)] += 7
+        b.validators[round_ + 5].exit_epoch = 100 + round_
+        _check(a)
+        _check(b)
+    # the feature under test: both lineages kept their own trie on the
+    # incremental path the whole time (no alias downgrade, no rebuild)
+    assert not entry_a.aliased and not entry_b.aliased
+    assert entry_a.trie is trie_a and entry_b.trie is trie_b
+
+
+def test_intra_list_alias_falls_back(genesis):
+    # ADVICE r3: the same Validator instance stored at two indices must
+    # not leave a stale row — alias detection downgrades the lineage to
+    # the full-diff path, which recomputes both rows
+    state = genesis.copy()
+    _check(state)                           # establish incremental base
+    v = state.validators[2]
+    state.validators[9] = v                 # alias: rows 2 and 9 share v
+    _check(state)
+    v.exit_epoch = 777                      # mutates BOTH rows' leaves
+    _check(state)
+    v.slashed = True                        # stays correct on re-root
+    _check(state)
+
+
+def test_fresh_instance_aliased_in_one_round(genesis):
+    # review r4: an instance with NO prior row hint placed at two
+    # indices in the same sync round — the seen-id pre-pass must catch
+    # it (the _vidx cross-check alone cannot)
+    state = genesis.copy()
+    _check(state)                           # incremental base
+    v = state.validators[0].copy()
+    state.validators[2] = v
+    state.validators[9] = v
+    _check(state)
+    v.exit_epoch = 777                      # both rows must re-leaf
+    _check(state)
+
+
+def test_cross_list_shared_instance(genesis):
+    # review r4: a validator moved between two tracked states WITHOUT
+    # .copy() — the first owner keeps hint-based patching, the second
+    # lineage must downgrade, and BOTH roots must stay correct
+    a = genesis.copy()
+    b = genesis.copy()
+    _check(a)
+    _check(b)
+    b.validators[5] = a.validators[5]       # shared instance
+    _check(b)
+    a.validators[5].exit_epoch = 42         # logs to a's lineage only
+    _check(a)
+    _check(b)
+    b.validators[5].slashed = True          # mutate via b's reference
+    _check(a)
+    _check(b)
+
+
+def _lineage(state, field="validators"):
+    cache = htr_cache._CACHES[type(state)]
+    lst = getattr(state, field)
+    return cache._lineages[field].get(lst.uid)
+
+
+def test_append_then_setitem_not_false_aliased(genesis):
+    # review r4: a setitem on a just-appended index lands in both the
+    # dirty set and the growth range — must not false-flag aliasing
+    state = genesis.copy()
+    _check(state)
+    v = state.validators[0].copy()
+    v.pubkey = b"\x55" * 48
+    state.validators.append(v)
+    w = state.validators[1].copy()
+    state.validators[len(state.validators) - 1] = w
+    _check(state)
+    entry = _lineage(state)
+    assert entry is not None and not entry.aliased
+
+
+def test_lru_evicted_lineage_reclaims_incremental(genesis):
+    # review r4: instances tagged by an LRU-evicted lineage must be
+    # reclaimable — the re-admitted state regains the O(changed) path
+    states = [genesis.copy() for _ in range(htr_cache._MAX_LINEAGES + 1)]
+    for s in states:
+        _check(s)                  # last admit evicts states[0]
+    assert _lineage(states[0]) is None
+    _check(states[0])              # re-admit: full resync reclaims tags
+    entry = _lineage(states[0])
+    assert entry is not None and not entry.aliased
+    states[0].validators[3].exit_epoch = 55
+    _check(states[0])
+    assert not entry.aliased       # stayed on the incremental path
+
+
+def test_alias_detected_at_full_rebuild():
+    # aliasing present from the first root (never an incremental base)
+    state = deterministic_genesis_state(24)
+    state.validators[3] = state.validators[7]
+    _check(state)
+    state.validators[7].effective_balance = 1
+    _check(state)
+
+
 def test_validator_root_instance_cache_invalidation():
     v = pt.Validator(pubkey=b"\x01" * 48,
                      withdrawal_credentials=b"\x02" * 32,
