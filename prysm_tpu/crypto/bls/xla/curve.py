@@ -259,14 +259,70 @@ def scalar_mul_windowed(ops: FieldOps, pt, scalar_bits):
     ~2^64 so the accumulator can never collide with a table entry.
     Production RLC scalars are 64-bit; do NOT use this for general
     255-bit scalars without an exceptional-case audit."""
-    nbits = scalar_bits.shape[0]
-    assert nbits % _WINDOW == 0, "bit count must be a window multiple"
-    nwin = nbits // _WINDOW
+    # table[d] = [d]P built level-wise (6 batched point ops, not 14
+    # sequential); one-hot contraction instead of gather; see the
+    # _small_multiple_table/_table_entry helpers (shared with the GLV
+    # path below)
+    digits = _window_digits(scalar_bits)
+    table = _small_multiple_table(ops, pt)
 
-    # table[d] = [d]P, built LEVEL-wise so the whole 16-entry table
-    # costs 6 batched point ops (3 levels x (1 dbl + 1 add)), not 14
-    # sequential ones: level k maps [T_d] -> [T_2d, T_2d+1] via one
-    # batched double + one batched unequal add, interleaved.
+    def body(acc, digit):
+        for _ in range(_WINDOW):
+            acc = point_double(ops, acc)
+        acc = point_add_unequal(ops, acc,
+                                _table_entry(ops, table, digit))
+        return acc, None
+
+    inf = point_inf_like(ops, pt)
+    out, _ = lax.scan(body, inf, digits)
+    return out
+
+
+# --- GLV/GLS half-width scalar multiplication ------------------------------
+#
+# BLS12-381 admits the curve automorphism (x, y) -> (zeta * x, y) with
+# zeta a primitive cube root of unity in Fp, acting on the order-R
+# subgroup as multiplication by LAMBDA = x_BLS^2 - 1 (a root of
+# l^2 + l + 1 = 0 mod R).  The SAME eigenvalue works on G1 (beta) and
+# on the G2 twist (zeta in Fp subset Fq2) — constants determined
+# empirically against the pure implementation and locked by
+# tests/test_xla_curve.py.  An RLC scalar sampled directly as
+# r = b0 + b1*LAMBDA (b0, b1 uniform 32-bit, b0 odd) then needs only
+# 32 shared doublings + two interleaved window-add streams instead of
+# 64 doublings: the map (b0, b1) -> r is injective (LAMBDA ~ 2^128 >>
+# 2^32, and b0 + b1*LAMBDA < 2^161 << R), so the 2^-63 RLC soundness
+# bound is unchanged [SURVEY §7 hard part #1; VERDICT r2 #2 MSM item].
+
+GLV_LAMBDA = 0xac45a4010001a40200000000ffffffff
+_G1_BETA = int(
+    "0x1a0111ea397fe699ec02408663d4de85aa0d857d89759ad4897d29650fb85f"
+    "9b409427eb4f49fffd8bfd00000000aaac", 16)
+_G2_ZETA = int(
+    "0x5f19672fdf76ce51ba69c6076a0f77eaddb3a93be6f89688de17d813620a00"
+    "022e01fffffffefffe", 16)
+
+
+def _endo_x_mul(ops: FieldOps, x):
+    """Multiply an X coordinate by the group's cube-root-of-unity
+    constant (Fp mul for G1; Fp-scalar Fq2 mul for the G2 twist)."""
+    if ops.ndims == 1:
+        return L.fp_mul(x, jnp.asarray(L.pack_ints([_G1_BETA])[0]))
+    return T.fq2_mul_fp(x, jnp.asarray(L.pack_ints([_G2_ZETA])[0]))
+
+
+def _window_digits(bits):
+    """uint32[nbits, ...] MSB-first -> (nbits/4, ...) window digits."""
+    nbits = bits.shape[0]
+    assert nbits % _WINDOW == 0
+    w = bits.reshape((nbits // _WINDOW, _WINDOW) + bits.shape[1:])
+    digits = jnp.zeros_like(w[:, 0])
+    for i in range(_WINDOW):
+        digits = (digits << 1) | w[:, i]
+    return digits
+
+
+def _small_multiple_table(ops: FieldOps, pt):
+    """16-entry [d]P table, built level-wise (6 batched point ops)."""
     inf = point_inf_like(ops, pt)
     level = tuple(t[None] for t in pt)               # [T_1]
     tiers = [tuple(t[None] for t in inf), level]     # [T_0], [T_1]
@@ -279,30 +335,56 @@ def scalar_mul_windowed(ops: FieldOps, pt, scalar_bits):
             jnp.stack([e, o], axis=1).reshape((-1,) + e.shape[1:])
             for e, o in zip(evens, odds))
         tiers.append(level)
-    table = tuple(jnp.concatenate([t[i] for t in tiers], axis=0)
-                  for i in range(3))                 # (16, ..., limbs)
+    return tuple(jnp.concatenate([t[i] for t in tiers], axis=0)
+                 for i in range(3))                  # (16, ..., limbs)
 
-    # bit planes -> window digits (nwin, ...)
-    w = scalar_bits.reshape((nwin, _WINDOW) + scalar_bits.shape[1:])
-    digits = jnp.zeros_like(w[:, 0])
-    for i in range(_WINDOW):
-        digits = (digits << 1) | w[:, i]
 
-    def body(acc, digit):
+def _table_entry(ops: FieldOps, table, digit):
+    """One-hot table contraction (exact in uint32, no gather)."""
+    d = jnp.expand_dims(digit, tuple(range(-ops.ndims, 0)))[None]
+    dvals = jnp.arange(1 << _WINDOW, dtype=jnp.uint32).reshape(
+        (1 << _WINDOW,) + (1,) * (d.ndim - 1))
+    onehot = (d == dvals).astype(jnp.uint32)
+    return tuple(jnp.sum(t * onehot, axis=0) for t in table)
+
+
+def scalar_mul_windowed_glv(ops: FieldOps, pt, r_bits):
+    """[b0 + b1*GLV_LAMBDA] P with b1 = r_bits[:n/2], b0 = r_bits[n/2:]
+    (MSB-first bit planes) — HALF the doublings of the plain windowed
+    ladder via the endomorphism table [d]([LAMBDA]P) = endo([d]P).
+
+    Sequential depth per window step: 4 doublings + 2 unequal adds,
+    over nbits/8 steps (a 64-bit plane runs 8 steps = 32 dbl + 16 add
+    vs 64 dbl + 16 add for scalar_mul_windowed).
+
+    point_add_unequal safety: the accumulator always holds
+    [c0]P + [c1*L]P with c0, c1 < 2^32, c0 = 0 (mod 16) before the
+    first add and c1 = 0 (mod 16) before the second; a collision with
+    a table entry [d]P / [d*L]P forces (via the injectivity of
+    (c0, c1) -> c0 + c1*L below 2^161 << R) c0 = c1 = d = 0, i.e. both
+    operands at infinity, which the formulas' selects handle."""
+    nbits = r_bits.shape[0]
+    assert nbits % (2 * _WINDOW) == 0, "need whole windows per half"
+    half = nbits // 2
+    d1 = _window_digits(r_bits[:half])
+    d0 = _window_digits(r_bits[half:])
+
+    table0 = _small_multiple_table(ops, pt)
+    # endo maps [d]P -> [d]([LAMBDA]P): one batched X-coordinate mul
+    table1 = (_endo_x_mul(ops, table0[0]), table0[1], table0[2])
+
+    def body(acc, digits):
+        dd0, dd1 = digits
         for _ in range(_WINDOW):
             acc = point_double(ops, acc)
-        # digit: (batch...) -> (1, batch..., 1[, 1]) aligned with the
-        # table's (16, batch..., [2,] limbs)
-        d = jnp.expand_dims(digit, tuple(range(-ops.ndims, 0)))[None]
-        dvals = jnp.arange(1 << _WINDOW, dtype=jnp.uint32).reshape(
-            (1 << _WINDOW,) + (1,) * (d.ndim - 1))
-        onehot = (d == dvals).astype(jnp.uint32)
-        entry = tuple(jnp.sum(t * onehot, axis=0) for t in table)
-        acc = point_add_unequal(ops, acc, entry)
+        acc = point_add_unequal(ops, acc,
+                                _table_entry(ops, table0, dd0))
+        acc = point_add_unequal(ops, acc,
+                                _table_entry(ops, table1, dd1))
         return acc, None
 
     inf = point_inf_like(ops, pt)
-    out, _ = lax.scan(body, inf, digits)
+    out, _ = lax.scan(body, inf, (d0, d1))
     return out
 
 

@@ -29,7 +29,7 @@ from . import limbs as L
 from . import tower as T
 from .curve import (
     FP_OPS, FQ2_OPS, g1_to_affine, g2_to_affine, pack_g1_points,
-    point_sum_tree, scalar_mul, scalar_mul_windowed,
+    point_sum_tree, scalar_mul, scalar_mul_windowed_glv,
     scalar_bits_from_ints, point_select, point_inf_like,
 )
 from .pairing import (
@@ -126,12 +126,12 @@ def rlc_batch_verify_device(pk_jac, sig_jac, h_jac, r_bits, mask):
     r_bits: uint32 (nbits, n) random scalars (MSB-first);
     mask: bool (n,) — padding entries contribute nothing."""
     # [r_i] sig_i, summed -> S
-    r_sigs = scalar_mul_windowed(FQ2_OPS, sig_jac, r_bits)
+    r_sigs = scalar_mul_windowed_glv(FQ2_OPS, sig_jac, r_bits)
     r_sigs = point_select(FQ2_OPS, mask, r_sigs,
                           point_inf_like(FQ2_OPS, r_sigs))
     s = point_sum_tree(FQ2_OPS, r_sigs)
     # [r_i] pk_i; one shared inversion for all affine conversions
-    r_pks = scalar_mul_windowed(FP_OPS, pk_jac, r_bits)
+    r_pks = scalar_mul_windowed_glv(FP_OPS, pk_jac, r_bits)
     g2_all = tuple(jnp.concatenate([t_s[None], t_h], axis=0)
                    for t_s, t_h in zip(s, h_jac))
     (px, py, p_inf), (qx, qy, q_inf) = _batch_affine(r_pks, g2_all)
@@ -155,9 +155,9 @@ def slot_verify_device(pk_jac, sig_jac, h_jac, r_bits):
     # per-committee aggregate pubkey: tree-sum over the validator axis
     pk_t = tuple(jnp.moveaxis(t, 1, 0) for t in pk_jac)   # (K, C, ...)
     apk = point_sum_tree(FP_OPS, pk_t)                    # (C, ...)
-    # RLC (4-bit windowed: nbits doublings, nbits/4 adds)
-    r_apk = scalar_mul_windowed(FP_OPS, apk, r_bits)
-    r_sig = scalar_mul_windowed(FQ2_OPS, sig_jac, r_bits)
+    # RLC (GLV half-width windowed: nbits/2 doublings, nbits/4 adds)
+    r_apk = scalar_mul_windowed_glv(FP_OPS, apk, r_bits)
+    r_sig = scalar_mul_windowed_glv(FQ2_OPS, sig_jac, r_bits)
     s = point_sum_tree(FQ2_OPS, r_sig)
     # affine (one shared Fermat scan for all of r_apk, S, H) + pairing
     g2_all = tuple(jnp.concatenate([t_s[None], t_h], axis=0)
@@ -202,8 +202,8 @@ def _sharded_slot_verify_traced(mesh, pk_jac, sig_jac, h_jac, r_bits):
     def local_work(pk, sig, h, rb):
         # pk arrives as (K, C_local, ...): sum over the validator axis
         apk = point_sum_tree(FP_OPS, pk)
-        r_apk = scalar_mul_windowed(FP_OPS, apk, rb)
-        r_sig = scalar_mul_windowed(FQ2_OPS, sig, rb)
+        r_apk = scalar_mul_windowed_glv(FP_OPS, apk, rb)
+        r_sig = scalar_mul_windowed_glv(FQ2_OPS, sig, rb)
         s_part = point_sum_tree(FQ2_OPS, r_sig)
         (ax, ay, a_inf), (hx, hy, _) = _batch_affine(r_apk, h)
         f = miller_loop((ax, ay), (hx, hy))
@@ -230,14 +230,25 @@ def _sharded_slot_verify_traced(mesh, pk_jac, sig_jac, h_jac, r_bits):
 
 
 def random_rlc_bits(n: int, rng=None, nbits: int = 64) -> jnp.ndarray:
-    """n random nonzero RLC scalars as MSB-first bit planes.
+    """n random RLC scalars as MSB-first bit planes, in GLV-half form.
 
-    ``nbits`` is the soundness parameter (2^-nbits+1 forgery odds for
-    the batch); 64 is the production width, small widths serve
-    structural dryruns/tests where the scan length dominates compile
-    time."""
+    The device scalar-mul (curve.scalar_mul_windowed_glv) reads rows
+    [:nbits/2] as b1 and [nbits/2:] as b0 and multiplies by the
+    EFFECTIVE scalar r = b0 + b1*GLV_LAMBDA (mod R) — half the
+    doublings of a plain nbits-bit ladder.  Soundness is unchanged:
+    (b0, b1) -> r is injective (b0 + b1*LAMBDA < 2^161 << R), b0 is
+    forced odd so r != 0, and the sample space stays 2^(nbits-1), so a
+    forged batch survives the combination with odds 2^-(nbits-1).
+    64 is the production width; small widths serve structural
+    dryruns/tests where the scan length dominates compile time."""
     if rng is None:
         rng = np.random.default_rng()
-    hi = 1 << min(nbits, 63)
-    scalars = [int(rng.integers(1, hi)) | 1 for _ in range(n)]
+    assert nbits % 8 == 0, "GLV halves need whole 4-bit windows"
+    half = nbits // 2
+    hi = 1 << half
+    scalars = []
+    for _ in range(n):
+        b1 = int(rng.integers(0, hi))        # full half-width
+        b0 = int(rng.integers(0, hi)) | 1    # odd -> r nonzero
+        scalars.append((b1 << half) | b0)
     return scalar_bits_from_ints(scalars, nbits)

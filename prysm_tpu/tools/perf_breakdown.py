@@ -1,20 +1,21 @@
 """Per-stage slot-verify breakdown on the real TPU (VERDICT r2 #2).
 
-Times each stage of ``slot_verify_device`` as its own jitted dispatch
-with the honest methodology (rotated inputs + forced small readback),
-so optimization wins are attributable:
+Round-3's version timed each stage as its own dispatch; through the
+axon tunnel every dispatch carries a large and RUN-VARIABLE rpc floor,
+so the stage numbers didn't add up (stages summed to more than the
+fused graph).  This version times PREFIX COMPOSITIONS of the pipeline
+— each prefix is one jitted dispatch ending in a tiny readback — and
+reports consecutive differences, so the floor cancels:
 
-  aggregate   per-committee pubkey tree-sum        (point_sum_tree)
-  scalar_mul  windowed RLC [r]apk + [r]sig         (scalar_mul_windowed)
-  affine      shared-inversion affine conversions  (_batch_affine)
-  miller      65-pairing Miller loop               (miller_loop)
-  final_exp   check final exponentiation           (final_exponentiation_check)
-  full_slot   the whole fused dispatch             (slot_verify_device)
+  p0  floor            tiny passthrough (the dispatch cost itself)
+  p1  + aggregate      per-committee pubkey tree-sum
+  p2  + scalar_mul     RLC [r]apk + [r]sig
+  p3  + affine         [r]sig tree-sum + shared-inversion affine
+  p4  + miller         65-pairing Miller loop
+  p5  + final_exp      prod tree + check final exp  (== full slot)
 
-Stage outputs feed the next stage's inputs (precomputed once, then
-rotated across 2 variants).  Writes JSON to stdout and
-``BREAKDOWN.json``.  Run attached to the TPU (no JAX_PLATFORMS=cpu);
-uses the persistent .jax_cache.
+Writes JSON to stdout and ``BREAKDOWN.json``.  Run attached to the
+TPU (no JAX_PLATFORMS=cpu); uses the persistent .jax_cache.
 
 Usage: python -m prysm_tpu.tools.perf_breakdown [C] [K]
 """
@@ -29,23 +30,16 @@ import time
 from ..utils import jaxenv
 
 
-def _sync(r):
-    import jax
+def _time(fn, variants, iters=5, warmup=2):
     import numpy as np
 
-    for leaf in jax.tree_util.tree_leaves(r):
-        np.asarray(leaf[..., :1] if hasattr(leaf, "ndim") and leaf.ndim
-                   else leaf)
-
-
-def _time(fn, variants, iters=4, warmup=2):
     times = []
     for i in range(warmup):
-        _sync(fn(*variants[i % len(variants)]))
+        np.asarray(fn(*variants[i % len(variants)]))
     for i in range(iters):
         a = variants[i % len(variants)]
         t0 = time.perf_counter()
-        _sync(fn(*a))
+        np.asarray(fn(*a))
         times.append(time.perf_counter() - t0)
     times.sort()
     return times[len(times) // 2]
@@ -63,73 +57,98 @@ def main() -> None:
     from ..crypto.bls import bls
     from ..crypto.bls.xla import tower as T
     from ..crypto.bls.xla.curve import (
-        FP_OPS, FQ2_OPS, point_sum_tree, scalar_mul_windowed,
+        FP_OPS, FQ2_OPS, point_sum_tree, scalar_mul_windowed_glv,
     )
     from ..crypto.bls.xla.pairing import (
-        final_exponentiation_check, fq12_prod_tree, miller_loop,
+        final_exponentiation_check, fq12_prod_tree, is_fq12_one,
+        miller_loop,
     )
     from ..crypto.bls.xla.verify import (
         _batch_affine, _neg_g1_affine, random_rlc_bits,
-        slot_verify_device,
     )
 
     batch = bls.build_synthetic_slot_batch(C, K)
     pk, sig, h = batch["pk_jac"], batch["sig_jac"], batch["h_jac"]
-    rb = [batch["r_bits"],
-          random_rlc_bits(C, np.random.default_rng(4242))]
+    rbs = [batch["r_bits"],
+           random_rlc_bits(C, np.random.default_rng(4242)),
+           random_rlc_bits(C, np.random.default_rng(777))]
 
-    results: dict[str, float] = {}
-
-    # 1. aggregate
-    agg = jax.jit(lambda p: point_sum_tree(
-        FP_OPS, tuple(jnp.moveaxis(t, 1, 0) for t in p)))
-    pk2 = tuple(jnp.roll(t, 1, axis=0) for t in pk)
-    results["aggregate_ms"] = _time(agg, [(pk,), (pk2,)]) * 1e3
-    apk = jax.block_until_ready(agg(pk))
-
-    # 2. windowed scalar muls (both groups, one dispatch)
-    smul = jax.jit(lambda a, s, r: (
-        scalar_mul_windowed(FP_OPS, a, r),
-        scalar_mul_windowed(FQ2_OPS, s, r)))
-    results["scalar_mul_ms"] = _time(
-        smul, [(apk, sig, rb[0]), (apk, sig, rb[1])]) * 1e3
-    r_apk, r_sig = jax.block_until_ready(smul(apk, sig, rb[0]))
-
-    # 3. affine (incl. the [r]sig tree-sum, matching the slot graph)
-    def affine(ra, rs, hh):
-        s = point_sum_tree(FQ2_OPS, rs)
-        g2 = tuple(jnp.concatenate([t_s[None], t_h], axis=0)
-                   for t_s, t_h in zip(s, hh))
-        return _batch_affine(ra, g2)
-
-    aff = jax.jit(affine)
-    ra2 = tuple(jnp.roll(t, 1, axis=0) for t in r_apk)
-    results["affine_ms"] = _time(
-        aff, [(r_apk, r_sig, h), (ra2, r_sig, h)]) * 1e3
-    (ax, ay, _), (qx, qy, _) = jax.block_until_ready(
-        aff(r_apk, r_sig, h))
-
-    # 4. miller loop (65 pairings: -g1/S + C committees)
     ng_x, ng_y = _neg_g1_affine()
-    px = jnp.concatenate([ng_x[None], ax], axis=0)
-    py = jnp.concatenate([ng_y[None], ay], axis=0)
-    mil = jax.jit(miller_loop)
-    px2 = jnp.roll(px, 1, axis=0)
-    results["miller_ms"] = _time(
-        mil, [((px, py), (qx, qy)), ((px2, py), (qx, qy))]) * 1e3
-    f = jax.block_until_ready(mil((px, py), (qx, qy)))
 
-    # 5. final exponentiation (prod tree + check exp)
-    fexp = jax.jit(lambda x: final_exponentiation_check(
-        fq12_prod_tree(x)))
-    f2 = jnp.roll(f, 1, axis=0)
-    results["final_exp_ms"] = _time(fexp, [(f,), (f2,)]) * 1e3
+    def tiny(*ts):
+        """Fold every stage output into ONE scalar so each prefix has
+        the same (minimal) readback."""
+        acc = jnp.uint32(0)
+        for t in ts:
+            acc = acc + jnp.sum(t.astype(jnp.uint32) & jnp.uint32(1))
+        return acc
 
-    # 6. the whole fused dispatch
-    results["full_slot_ms"] = _time(
-        slot_verify_device,
-        [(pk, sig, h, rb[0]), (pk, sig, h, rb[1])]) * 1e3
+    def p0(pk, sig, h, rb):
+        return tiny(pk[0][..., 0], rb)
 
+    def p1(pk, sig, h, rb):
+        pk_t = tuple(jnp.moveaxis(t, 1, 0) for t in pk)
+        apk = point_sum_tree(FP_OPS, pk_t)
+        return tiny(*apk, rb)
+
+    def _to_smul(pk, sig, rb):
+        pk_t = tuple(jnp.moveaxis(t, 1, 0) for t in pk)
+        apk = point_sum_tree(FP_OPS, pk_t)
+        r_apk = scalar_mul_windowed_glv(FP_OPS, apk, rb)
+        r_sig = scalar_mul_windowed_glv(FQ2_OPS, sig, rb)
+        return r_apk, r_sig
+
+    def p2(pk, sig, h, rb):
+        r_apk, r_sig = _to_smul(pk, sig, rb)
+        return tiny(*r_apk, *r_sig)
+
+    def _to_affine(pk, sig, h, rb):
+        r_apk, r_sig = _to_smul(pk, sig, rb)
+        s = point_sum_tree(FQ2_OPS, r_sig)
+        g2_all = tuple(jnp.concatenate([t_s[None], t_h], axis=0)
+                       for t_s, t_h in zip(s, h))
+        (ax, ay, a_inf), (qx, qy, q_inf) = _batch_affine(r_apk, g2_all)
+        p_x = jnp.concatenate([ng_x[None], ax], axis=0)
+        p_y = jnp.concatenate([ng_y[None], ay], axis=0)
+        return p_x, p_y, qx, qy, a_inf, q_inf
+
+    def p3(pk, sig, h, rb):
+        p_x, p_y, qx, qy, a_inf, q_inf = _to_affine(pk, sig, h, rb)
+        return tiny(p_x, p_y, qx, qy)
+
+    def _to_miller(pk, sig, h, rb):
+        p_x, p_y, qx, qy, a_inf, q_inf = _to_affine(pk, sig, h, rb)
+        f = miller_loop((p_x, p_y), (qx, qy))
+        return f, a_inf, q_inf
+
+    def p4(pk, sig, h, rb):
+        f, _, _ = _to_miller(pk, sig, h, rb)
+        return tiny(f)
+
+    def p5(pk, sig, h, rb):
+        f, a_inf, q_inf = _to_miller(pk, sig, h, rb)
+        mask = jnp.concatenate([~q_inf[:1], ~a_inf], axis=0)
+        f = T.fq12_select(mask, f, T.fq12_one_like(f))
+        out = final_exponentiation_check(fq12_prod_tree(f))
+        return is_fq12_one(out)
+
+    prefixes = [("floor", p0), ("aggregate", p1), ("scalar_mul", p2),
+                ("affine", p3), ("miller", p4), ("final_exp", p5)]
+    raw: dict[str, float] = {}
+    for name, fn in prefixes:
+        jfn = jax.jit(fn)
+        variants = [(pk, sig, h, rb) for rb in rbs]
+        raw[name] = _time(jfn, variants) * 1e3
+        print(f"# prefix {name}: {raw[name]:.1f} ms", file=sys.stderr,
+              flush=True)
+
+    results: dict[str, object] = {
+        "prefix_ms": {k: round(v, 2) for k, v in raw.items()}}
+    order = [n for n, _ in prefixes]
+    for prev, cur in zip(order, order[1:]):
+        results[f"{cur}_ms"] = round(raw[cur] - raw[prev], 2)
+    results["full_slot_ms"] = round(raw["final_exp"], 2)
+    results["device_compute_ms"] = round(raw["final_exp"] - raw["floor"], 2)
     results["shape"] = f"{C}x{K}"
     results["backend"] = jax.default_backend()
     out = json.dumps(results)

@@ -135,6 +135,31 @@ class TestWindowedScalarMul:
         assert C.unpack_g1_points(got1) == want1
         assert C.unpack_g2_points(got2) == want2
 
+    def test_g1_and_g2_glv_64bit(self, rng):
+        """GLV half-width path vs pure: r_bits rows [:32] are b1,
+        [32:] are b0, effective scalar r = b0 + b1*LAMBDA mod R.
+        Covers random halves plus the (0, 0), (1, 0), (0, 1) edges
+        and an infinity base — one compiled graph per group."""
+        import jax
+
+        pairs = [(rng.randrange(1 << 32) | 1, rng.randrange(1 << 32))
+                 for _ in range(2)] + [(0, 0), (1, 0), (0, 1)]
+        ks = [(b0 + b1 * C.GLV_LAMBDA) % R for b0, b1 in pairs]
+        packed = [(b1 << 32) | b0 for b0, b1 in pairs]
+        bits = C.scalar_bits_from_ints(packed, 64)
+        g1s = rand_g1(rng, len(pairs) - 1) + [None]
+        g2s = rand_g2(rng, len(pairs))
+        fn = jax.jit(lambda p, q, b: (
+            C.scalar_mul_windowed_glv(C.FP_OPS, p, b),
+            C.scalar_mul_windowed_glv(C.FQ2_OPS, q, b)))
+        got1, got2 = fn(C.pack_g1_points(g1s), C.pack_g2_points(g2s),
+                        bits)
+        want1 = [pc.multiply(p, k) if p is not None else None
+                 for p, k in zip(g1s, ks)]
+        want2 = [pc.multiply(q, k) for q, k in zip(g2s, ks)]
+        assert C.unpack_g1_points(got1) == want1
+        assert C.unpack_g2_points(got2) == want2
+
     def test_unequal_add_matches_general(self, rng):
         p, q = rand_g1(rng, 2)
         dev_p = C.pack_g1_points([p, p, None])
