@@ -156,6 +156,15 @@ class Signature:
             [p.point for p in pks], msgs, self._pt, dst)
 
 
+def pure_verify(pk: PublicKey, msg: bytes, sig: Signature,
+                dst: bytes = ETH2_DST) -> bool:
+    """Single verify pinned to the host-side pure backend, regardless
+    of the --bls-implementation flag.  For host-path consumers
+    (discovery records, tooling) where one verification must not
+    trigger a device compile or wait on a busy device."""
+    return _PureBackend.verify(pk.point, msg, sig.point, dst)
+
+
 def pop_verify(pk: PublicKey, proof: Signature) -> bool:
     """Verify a proof of possession (deposit-processing dependency)."""
     return proof.verify(pk, pk.to_bytes(), dst=POP_DST)

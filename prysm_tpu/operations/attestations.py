@@ -145,9 +145,9 @@ class AttestationPool:
                              limit: int | None = None
                              ) -> list[Attestation]:
         """Best aggregates for block inclusion, most-bits-first
-        (proposer packing order)."""
-        cfg = beacon_config()
-        limit = limit if limit is not None else cfg.max_attestations
+        (proposer packing order).  ``limit=None`` means NO cap — block
+        packers pass their own max_attestations budget; pool listings
+        (the Beacon API pool endpoint) must see everything."""
         with self._lock:
             out: list[Attestation] = []
             for key, g in self._groups.items():
@@ -155,7 +155,7 @@ class AttestationPool:
                     continue
                 out.extend(g.aggregated)
             out.sort(key=lambda a: -sum(a.aggregation_bits))
-            return out[:limit]
+            return out if limit is None else out[:limit]
 
     def unaggregated_count(self) -> int:
         with self._lock:

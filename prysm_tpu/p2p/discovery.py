@@ -1,0 +1,239 @@
+"""Node records + bootnode directory: the discovery layer.
+
+Reference analog: discv5 ENRs and ``tools/bootnode`` (a discv5
+bootstrap node peers dial to learn the mesh) [U, SURVEY.md §2 "p2p",
+"tools"].  The rebuild's transport is the TCP+snappy bridge
+(p2p/transport.py), so discovery is rebuilt at that level:
+
+* ``NodeRecord`` — the ENR analog: (seq, node host/port, fork digest)
+  SIGNED with the node's BLS key (the framework's own crypto stack
+  instead of secp256k1), identity = sha256(pubkey)[:20], wire form a
+  base64url string with a ``pnr:`` prefix (cf. ``enr:``).  Records
+  with higher ``seq`` supersede lower ones, like ENR sequence numbers.
+* ``Bootnode`` — a tiny TCP directory: peers REGISTER their record
+  and LIST the currently-live records (TTL-expired entries drop out),
+  mirroring what a discv5 bootstrap node gives a joining peer: the
+  initial peer set.  Framing reuses the transport's varints.
+
+Record signatures make a poisoned directory detectable: ``decode``
+verifies before returning, so a bootnode (or a man in the middle)
+cannot forge records for identities it does not hold keys for —
+the same property ENR signatures give discv5.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+
+from ..crypto.bls import bls
+
+_DST_NODE_RECORD = b"PRYSM_TPU_NODE_RECORD"
+_PREFIX = "pnr:"
+
+
+class RecordError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class NodeRecord:
+    """Signed node record (ENR analog)."""
+
+    pubkey: bytes          # BLS pubkey, 48 bytes
+    host: str
+    port: int
+    fork_digest: bytes     # 4 bytes
+    seq: int               # supersession counter
+    signature: bytes       # BLS sig over the payload, 96 bytes
+
+    @property
+    def node_id(self) -> str:
+        return hashlib.sha256(self.pubkey).digest()[:20].hex()
+
+    # --- wire form ---------------------------------------------------------
+
+    def _payload(self) -> bytes:
+        host_b = self.host.encode()
+        return struct.pack("<QH4sB", self.seq, self.port,
+                           self.fork_digest, len(host_b)) + host_b
+
+    @classmethod
+    def create(cls, secret: "bls.SecretKey", host: str, port: int,
+               fork_digest: bytes = b"\x00" * 4,
+               seq: int = 1) -> "NodeRecord":
+        rec = cls(pubkey=secret.public_key().to_bytes(), host=host,
+                  port=port, fork_digest=fork_digest, seq=seq,
+                  signature=b"")
+        sig = secret.sign(rec._payload(), dst=_DST_NODE_RECORD)
+        return cls(pubkey=rec.pubkey, host=host, port=port,
+                   fork_digest=fork_digest, seq=seq,
+                   signature=sig.to_bytes())
+
+    def encode(self) -> str:
+        raw = self.pubkey + self.signature + self._payload()
+        return _PREFIX + base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+    @classmethod
+    def decode(cls, text: str) -> "NodeRecord":
+        """Parse AND verify; raises RecordError on any forgery."""
+        if not text.startswith(_PREFIX):
+            raise RecordError("missing pnr: prefix")
+        b64 = text[len(_PREFIX):]
+        try:
+            raw = base64.urlsafe_b64decode(b64 + "=" * (-len(b64) % 4))
+        except ValueError as e:
+            raise RecordError(f"bad base64: {e}") from None
+        if len(raw) < 48 + 96 + struct.calcsize("<QH4sB"):
+            raise RecordError("record too short")
+        pubkey, sig = raw[:48], raw[48:144]
+        payload = raw[144:]
+        seq, port, fork_digest, hlen = struct.unpack_from("<QH4sB",
+                                                          payload)
+        host_b = payload[struct.calcsize("<QH4sB"):]
+        if len(host_b) != hlen:
+            raise RecordError("host length mismatch")
+        rec = cls(pubkey=pubkey, host=host_b.decode(), port=port,
+                  fork_digest=fork_digest, seq=seq, signature=sig)
+        try:
+            pk = bls.PublicKey.from_bytes(pubkey)
+            sg = bls.Signature.from_bytes(sig)
+        except Exception as e:
+            raise RecordError(f"bad key/sig encoding: {e}") from None
+        # pinned to the pure host backend: discovery is host-side
+        # networking, and one record verify must never trigger a
+        # device compile or queue behind slot batches
+        if not bls.pure_verify(pk, rec._payload(), sg,
+                               dst=_DST_NODE_RECORD):
+            raise RecordError("signature verification failed")
+        return rec
+
+
+# --- bootnode directory ----------------------------------------------------
+#
+# Protocol (length-prefixed UTF-8 lines over one short-lived TCP
+# connection, mirroring a single discv5 FINDNODE round):
+#   client:  "REG <pnr:...>"   -> server: "OK" | "ERR <why>"
+#   client:  "LIST"            -> server: one record per line
+_MAX_LINE = 4096
+
+
+def _send_line(sock: socket.socket, text: str) -> None:
+    data = text.encode()
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_line(f) -> str:
+    hdr = f.read(4)
+    if len(hdr) != 4:
+        raise ConnectionError("peer closed")
+    (n,) = struct.unpack("<I", hdr)
+    if n > _MAX_LINE:
+        raise ValueError("line too long")
+    data = f.read(n)
+    if len(data) != n:
+        raise ConnectionError("truncated")
+    return data.decode()
+
+
+class Bootnode:
+    """TTL'd directory of verified node records."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 ttl: float = 600.0):
+        self.ttl = ttl
+        self._records: dict[str, tuple[float, NodeRecord]] = {}
+        self._lock = threading.Lock()
+        self._srv = socket.create_server((host, port))
+        self.port = self._srv.getsockname()[1]
+        self._closed = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._closed.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+    def records(self) -> list[NodeRecord]:
+        now = time.monotonic()
+        with self._lock:
+            live = {nid: (t, r) for nid, (t, r) in
+                    self._records.items() if now - t < self.ttl}
+            self._records = live
+            return [r for _, r in live.values()]
+
+    def _serve(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            # bound idle/half-open clients: without this an opened
+            # connection that never sends pins a thread+socket forever
+            conn.settimeout(10.0)
+            with conn, conn.makefile("rb") as f:
+                line = _recv_line(f)
+                if line.startswith("REG "):
+                    try:
+                        rec = NodeRecord.decode(line[4:])
+                    except RecordError as e:
+                        _send_line(conn, f"ERR {e}")
+                        return
+                    with self._lock:
+                        old = self._records.get(rec.node_id)
+                        # higher seq supersedes; stale re-registration
+                        # refreshes the TTL only
+                        if old is None or rec.seq >= old[1].seq:
+                            self._records[rec.node_id] = (
+                                time.monotonic(), rec)
+                    _send_line(conn, "OK")
+                elif line == "LIST":
+                    for rec in self.records():
+                        _send_line(conn, rec.encode())
+                    _send_line(conn, "")
+                else:
+                    _send_line(conn, "ERR unknown command")
+        except (ConnectionError, ValueError, OSError, TimeoutError):
+            pass
+
+
+def register(host: str, port: int, record: NodeRecord,
+             timeout: float = 5.0) -> None:
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        _send_line(s, "REG " + record.encode())
+        with s.makefile("rb") as f:
+            resp = _recv_line(f)
+    if resp != "OK":
+        raise RecordError(resp)
+
+
+def lookup(host: str, port: int,
+           timeout: float = 5.0) -> list[NodeRecord]:
+    """Fetch + verify the directory's records (forged entries raise
+    in decode, so a poisoned directory cannot go unnoticed)."""
+    out = []
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        _send_line(s, "LIST")
+        with s.makefile("rb") as f:
+            while True:
+                line = _recv_line(f)
+                if not line:
+                    break
+                out.append(NodeRecord.decode(line))
+    return out

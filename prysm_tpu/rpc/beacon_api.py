@@ -1,0 +1,494 @@
+"""Eth Beacon API data layer: states, blocks, pool, config, duties.
+
+Reference analog: ``beacon-chain/rpc/eth/`` (the standard Beacon API
+served through the grpc-gateway) [U, SURVEY.md §2 "RPC"].  This module
+builds the JSON payloads; ``http_server.py`` routes to it.  Ids follow
+the spec: ``state_id`` / ``block_id`` accept "head", "genesis",
+"finalized", "justified", a slot number, or a 0x-prefixed root.
+"""
+
+from __future__ import annotations
+
+from ..config import beacon_config
+from ..core.helpers import (
+    compute_start_slot_at_epoch, get_beacon_committee,
+    get_beacon_proposer_index_at_slot, get_committee_count_per_slot,
+    get_current_epoch,
+)
+from ..core.transition import process_slots
+from .api import APIError
+
+FAR_FUTURE_EPOCH = 2 ** 64 - 1
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + b.hex()
+
+
+def _unhex(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+
+class BeaconAPI:
+    """Standard Beacon API surface over one node's services."""
+
+    def __init__(self, node, validator_api=None):
+        self.node = node
+        if validator_api is None:
+            from .api import ValidatorAPI
+
+            validator_api = ValidatorAPI(node)
+        self.validator_api = validator_api
+
+    # --- id resolution -----------------------------------------------------
+
+    def resolve_state(self, state_id: str):
+        """state_id -> BeaconState (a private copy when advanced)."""
+        chain = self.node.chain
+        if state_id == "head":
+            return chain.stategen.state_by_root(chain.head_root)
+        if state_id == "genesis":
+            return self.node.db.genesis_state()
+        if state_id == "finalized":
+            return chain.stategen.state_by_root(
+                chain.finalized_checkpoint.root
+                if chain.finalized_checkpoint.root != b"\x00" * 32
+                else chain.genesis_root)
+        if state_id == "justified":
+            return chain.stategen.state_by_root(
+                chain.justified_checkpoint.root
+                if chain.justified_checkpoint.root != b"\x00" * 32
+                else chain.genesis_root)
+        if state_id.startswith("0x"):
+            # a STATE root: find the block DECLARING it along the head
+            # chain (block.state_root — no state regeneration or
+            # re-hashing for the search, so a garbage root cannot
+            # thrash the HTR cache), else try it as a block root
+            root = _unhex(state_id)
+            blk = self.node.db.block(root)
+            if blk is not None:
+                return chain.stategen.state_by_root(root)
+            for br in self._canonical_roots():
+                b = self.node.db.block(br)
+                if b is not None and b.message.state_root == root:
+                    return chain.stategen.state_by_root(br)
+            raise APIError(f"unknown state {state_id}")
+        slot = int(state_id)
+        anchor = chain.forkchoice.ancestor_at_slot(chain.head_root,
+                                                   slot)
+        if anchor is not None:
+            st = chain.stategen.state_by_root(anchor)
+            if st.slot < slot:        # empty slots after the anchor
+                process_slots(st, slot, self.node.types)
+            return st
+        # ahead of the head block: advance along the head chain
+        return chain.stategen.state_by_slot_along(chain.head_root,
+                                                  slot)
+
+    def resolve_block(self, block_id: str):
+        """block_id -> (signed_block, root)."""
+        chain = self.node.chain
+        db = self.node.db
+        if block_id == "head":
+            root = chain.head_root
+        elif block_id == "genesis":
+            root = chain.genesis_root
+        elif block_id == "finalized":
+            root = (chain.finalized_checkpoint.root
+                    if chain.finalized_checkpoint.root != b"\x00" * 32
+                    else chain.genesis_root)
+        elif block_id.startswith("0x"):
+            root = _unhex(block_id)
+        else:
+            slot = int(block_id)
+            root = chain.forkchoice.ancestor_at_slot(chain.head_root,
+                                                     slot)
+            if root is None:
+                raise APIError(f"no canonical block at slot {slot}")
+        blk = db.block(root)
+        if blk is None and root == chain.genesis_root:
+            return None, root     # genesis has no stored block
+        if blk is None:
+            raise APIError(f"unknown block {block_id}")
+        return blk, root
+
+    def _canonical_roots(self):
+        """Head-chain block roots, newest first (bounded walk)."""
+        chain = self.node.chain
+        fc = chain.forkchoice
+        root = chain.head_root
+        out = []
+        while True:
+            out.append(root)
+            if not fc.has_node(root):
+                break
+            node = fc.node(root)
+            if node.parent < 0:
+                break
+            root = fc.nodes[node.parent].root
+        return out
+
+    # --- beacon/genesis + states -------------------------------------------
+
+    def genesis(self) -> dict:
+        st = self.node.db.genesis_state()
+        cfg = beacon_config()
+        return {"data": {
+            "genesis_time": str(st.genesis_time),
+            "genesis_validators_root": _hex(st.genesis_validators_root),
+            "genesis_fork_version": _hex(cfg.genesis_fork_version),
+        }}
+
+    def state_root(self, state_id: str) -> dict:
+        st = self.resolve_state(state_id)
+        return {"data": {"root": _hex(type(st).hash_tree_root(st))}}
+
+    def state_fork(self, state_id: str) -> dict:
+        st = self.resolve_state(state_id)
+        return {"data": {
+            "previous_version": _hex(st.fork.previous_version),
+            "current_version": _hex(st.fork.current_version),
+            "epoch": str(st.fork.epoch),
+        }}
+
+    def finality_checkpoints(self, state_id: str) -> dict:
+        st = self.resolve_state(state_id)
+
+        def cp(c):
+            return {"epoch": str(c.epoch), "root": _hex(c.root)}
+
+        return {"data": {
+            "previous_justified": cp(st.previous_justified_checkpoint),
+            "current_justified": cp(st.current_justified_checkpoint),
+            "finalized": cp(st.finalized_checkpoint),
+        }}
+
+    # --- validators ---------------------------------------------------------
+
+    @staticmethod
+    def _validator_status(v, epoch: int) -> str:
+        """Beacon-API status decision tree."""
+        if epoch < v.activation_epoch:
+            if v.activation_eligibility_epoch == FAR_FUTURE_EPOCH:
+                return "pending_initialized"
+            return "pending_queued"
+        if epoch < v.exit_epoch:
+            if v.slashed:
+                return "active_slashed"
+            if v.exit_epoch != FAR_FUTURE_EPOCH:
+                return "active_exiting"
+            return "active_ongoing"
+        if epoch < v.withdrawable_epoch:
+            return ("exited_slashed" if v.slashed
+                    else "exited_unslashed")
+        return "withdrawal_done"
+
+    def _validator_entry(self, st, i: int, epoch: int) -> dict:
+        v = st.validators[i]
+        return {
+            "index": str(i),
+            "balance": str(st.balances[i]),
+            "status": self._validator_status(v, epoch),
+            "validator": {
+                "pubkey": _hex(v.pubkey),
+                "withdrawal_credentials":
+                    _hex(v.withdrawal_credentials),
+                "effective_balance": str(v.effective_balance),
+                "slashed": bool(v.slashed),
+                "activation_eligibility_epoch":
+                    str(v.activation_eligibility_epoch),
+                "activation_epoch": str(v.activation_epoch),
+                "exit_epoch": str(v.exit_epoch),
+                "withdrawable_epoch": str(v.withdrawable_epoch),
+            },
+        }
+
+    def _resolve_validator_indices(self, st, ids) -> list[int]:
+        """ids: decimal indices or 0x pubkeys; None -> all."""
+        if ids is None:
+            return list(range(len(st.validators)))
+        by_pk = None    # built lazily: numeric ids (the common case)
+        out = []        # must not pay a 500k-entry pubkey map
+        for vid in ids:
+            if vid.startswith("0x"):
+                if by_pk is None:
+                    by_pk = {bytes(v.pubkey): i
+                             for i, v in enumerate(st.validators)}
+                i = by_pk.get(_unhex(vid))
+                if i is not None:
+                    out.append(i)
+            else:
+                i = int(vid)
+                if i < len(st.validators):
+                    out.append(i)
+        return out
+
+    def validators(self, state_id: str, ids=None,
+                   statuses=None) -> dict:
+        st = self.resolve_state(state_id)
+        epoch = get_current_epoch(st)
+        entries = [self._validator_entry(st, i, epoch)
+                   for i in self._resolve_validator_indices(st, ids)]
+        if statuses:
+            entries = [e for e in entries if e["status"] in statuses]
+        return {"data": entries}
+
+    def validator(self, state_id: str, validator_id: str) -> dict:
+        st = self.resolve_state(state_id)
+        idx = self._resolve_validator_indices(st, [validator_id])
+        if not idx:
+            raise APIError(f"unknown validator {validator_id}")
+        return {"data": self._validator_entry(
+            st, idx[0], get_current_epoch(st))}
+
+    def validator_balances(self, state_id: str, ids=None) -> dict:
+        st = self.resolve_state(state_id)
+        return {"data": [
+            {"index": str(i), "balance": str(st.balances[i])}
+            for i in self._resolve_validator_indices(st, ids)]}
+
+    def committees(self, state_id: str, epoch: int | None = None,
+                   index: int | None = None,
+                   slot: int | None = None) -> dict:
+        st = self.resolve_state(state_id)
+        if epoch is None:
+            epoch = get_current_epoch(st)
+        start = compute_start_slot_at_epoch(epoch)
+        if st.slot < start:
+            st = st.copy()
+            process_slots(st, start, self.node.types)
+        count = get_committee_count_per_slot(st, epoch)
+        cfg = beacon_config()
+        out = []
+        for s in range(start, start + cfg.slots_per_epoch):
+            if slot is not None and s != slot:
+                continue
+            for ci in range(count):
+                if index is not None and ci != index:
+                    continue
+                members = get_beacon_committee(st, s, ci)
+                out.append({"index": str(ci), "slot": str(s),
+                            "validators": [str(m) for m in members]})
+        return {"data": out}
+
+    # --- headers / blocks ---------------------------------------------------
+
+    def _header_payload(self, blk, root: bytes) -> dict:
+        chain = self.node.chain
+        canonical = chain.forkchoice.ancestor_at_slot(
+            chain.head_root,
+            blk.message.slot if blk else 0) == root
+        if blk is None:      # genesis
+            st = self.node.db.genesis_state()
+            hdr = {"slot": "0", "proposer_index": "0",
+                   "parent_root": _hex(b"\x00" * 32),
+                   "state_root":
+                       _hex(type(st).hash_tree_root(st)),
+                   "body_root": _hex(b"\x00" * 32)}
+            sig = b"\x00" * 96
+        else:
+            m = blk.message
+            hdr = {"slot": str(m.slot),
+                   "proposer_index": str(m.proposer_index),
+                   "parent_root": _hex(m.parent_root),
+                   "state_root": _hex(m.state_root),
+                   "body_root": _hex(type(m.body).hash_tree_root(
+                       m.body))}
+            sig = blk.signature
+        return {"root": _hex(root), "canonical": bool(canonical),
+                "header": {"message": hdr, "signature": _hex(sig)}}
+
+    def header(self, block_id: str) -> dict:
+        blk, root = self.resolve_block(block_id)
+        return {"data": self._header_payload(blk, root)}
+
+    def headers(self, slot: int | None = None,
+                parent_root: bytes | None = None) -> dict:
+        chain = self.node.chain
+        if parent_root is not None:
+            fc = chain.forkchoice
+            if not fc.has_node(parent_root):
+                return {"data": []}
+            node = fc.node(parent_root)
+            roots = [fc.nodes[c].root for c in node.children]
+        elif slot is not None:
+            fc = chain.forkchoice
+            roots = [n.root for n in fc.nodes if n.slot == slot]
+        else:
+            roots = [chain.head_root]
+        out = []
+        for r in roots:
+            blk, r = self.resolve_block(_hex(r))
+            out.append(self._header_payload(blk, r))
+        return {"data": out}
+
+    def block_ssz(self, block_id: str) -> tuple[bytes, bytes]:
+        blk, root = self.resolve_block(block_id)
+        if blk is None:
+            raise APIError("genesis has no block")
+        return self.node.types.SignedBeaconBlock.serialize(blk), root
+
+    def block_root(self, block_id: str) -> dict:
+        _, root = self.resolve_block(block_id)
+        return {"data": {"root": _hex(root)}}
+
+    def block_attestations(self, block_id: str) -> dict:
+        from ..proto import Attestation
+
+        blk, _ = self.resolve_block(block_id)
+        if blk is None:
+            return {"data": []}
+        return {"data": [
+            _hex(Attestation.serialize(a))
+            for a in blk.message.body.attestations]}
+
+    # --- pool ---------------------------------------------------------------
+
+    def pool_attestations(self) -> dict:
+        from ..proto import Attestation
+
+        pool = self.node.att_pool
+        atts = list(pool.aggregated_for_block(slot=None, limit=None))
+        return {"data": [_hex(Attestation.serialize(a))
+                         for a in atts]}
+
+    def pool_attester_slashings(self) -> dict:
+        from ..proto import AttesterSlashing
+
+        return {"data": [
+            _hex(AttesterSlashing.serialize(s))
+            for s in self.node.slashing_pool
+                .pending_attester_slashings()]}
+
+    def pool_proposer_slashings(self) -> dict:
+        from ..proto import ProposerSlashing
+
+        return {"data": [
+            _hex(ProposerSlashing.serialize(s))
+            for s in self.node.slashing_pool
+                .pending_proposer_slashings()]}
+
+    def pool_voluntary_exits(self) -> dict:
+        from ..proto import SignedVoluntaryExit
+
+        return {"data": [
+            _hex(SignedVoluntaryExit.serialize(e))
+            for e in self.node.exit_pool.pending()]}
+
+    def submit_voluntary_exit(self, raw: bytes) -> None:
+        from ..proto import SignedVoluntaryExit
+
+        exit_ = SignedVoluntaryExit.deserialize(raw)
+        if not self.node.exit_pool.insert(
+                self.node.chain.head_state, exit_):
+            raise APIError("exit rejected")
+
+    def submit_attester_slashing(self, raw: bytes) -> None:
+        from ..proto import AttesterSlashing
+
+        sl = AttesterSlashing.deserialize(raw)
+        if not self.node.slashing_pool.insert_attester_slashing(
+                self.node.chain.head_state, sl):
+            raise APIError("slashing rejected")
+
+    def submit_proposer_slashing(self, raw: bytes) -> None:
+        from ..proto import ProposerSlashing
+
+        sl = ProposerSlashing.deserialize(raw)
+        if not self.node.slashing_pool.insert_proposer_slashing(
+                self.node.chain.head_state, sl):
+            raise APIError("slashing rejected")
+
+    # --- config -------------------------------------------------------------
+
+    def spec(self) -> dict:
+        cfg = beacon_config()
+        out = {}
+        for name in cfg.__dataclass_fields__:
+            v = getattr(cfg, name)
+            if isinstance(v, bytes):
+                v = _hex(v)
+            elif isinstance(v, int):
+                v = str(v)
+            out[name.upper()] = v
+        return {"data": out}
+
+    def fork_schedule(self) -> dict:
+        cfg = beacon_config()
+        return {"data": [{
+            "previous_version": _hex(cfg.genesis_fork_version),
+            "current_version": _hex(cfg.genesis_fork_version),
+            "epoch": "0",
+        }]}
+
+    def deposit_contract(self) -> dict:
+        cfg = beacon_config()
+        return {"data": {
+            "chain_id": "1",
+            "address": _hex(getattr(cfg, "deposit_contract_address",
+                                    b"\x00" * 20)),
+        }}
+
+    # --- duties -------------------------------------------------------------
+
+    def proposer_duties(self, epoch: int) -> dict:
+        chain = self.node.chain
+        start = compute_start_slot_at_epoch(epoch)
+        anchor = chain.forkchoice.ancestor_at_slot(chain.head_root,
+                                                   start)
+        st = chain.stategen.state_by_root(
+            anchor if anchor is not None else chain.head_root)
+        if st.slot < start:
+            process_slots(st, start, self.node.types)
+        cfg = beacon_config()
+        out = []
+        for slot in range(max(start, 1),
+                          start + cfg.slots_per_epoch):
+            vi = get_beacon_proposer_index_at_slot(st, slot)
+            out.append({
+                "pubkey": _hex(bytes(st.validators[vi].pubkey)),
+                "validator_index": str(vi),
+                "slot": str(slot)})
+        return {"dependent_root": _hex(chain.head_root), "data": out}
+
+    def attester_duties(self, epoch: int, indices: list[int]) -> dict:
+        chain = self.node.chain
+        st = chain.head_state
+        pubkeys = [bytes(st.validators[i].pubkey) for i in indices
+                   if i < len(st.validators)]
+        duties = self.validator_api.get_duties(epoch, pubkeys)
+        out = []
+        for d in duties:
+            if d.attester_slot < 0:
+                continue
+            out.append({
+                "pubkey": _hex(d.pubkey),
+                "validator_index": str(d.validator_index),
+                "committee_index": str(d.committee_index),
+                "committee_length": str(len(d.committee)),
+                "committees_at_slot": str(get_committee_count_per_slot(
+                    st, epoch)),
+                "validator_committee_index": str(
+                    d.committee.index(d.validator_index)),
+                "slot": str(d.attester_slot)})
+        return {"dependent_root": _hex(chain.head_root), "data": out}
+
+    # --- debug --------------------------------------------------------------
+
+    def debug_heads(self) -> dict:
+        fc = self.node.chain.forkchoice
+        leaves = [n for n in fc.nodes if not n.children]
+        return {"data": [{"root": _hex(n.root), "slot": str(n.slot)}
+                         for n in leaves]}
+
+    def debug_forkchoice(self) -> dict:
+        fc = self.node.chain.forkchoice
+        return {"data": [{
+            "root": _hex(n.root),
+            "slot": str(n.slot),
+            "parent_root": (_hex(fc.nodes[n.parent].root)
+                            if n.parent >= 0 else None),
+            "weight": str(int(n.weight)),
+            "justified_epoch": str(n.justified_epoch),
+            "finalized_epoch": str(n.finalized_epoch),
+        } for n in fc.nodes]}

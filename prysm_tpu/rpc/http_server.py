@@ -4,25 +4,50 @@ Reference analog: the Eth Beacon API REST gateway + monitoring
 endpoints (``/eth/v1/node/health``, ``/metrics``) [U, SURVEY.md §2
 "RPC", "monitoring"].  stdlib http.server; JSON bodies; SSZ payloads
 hex-encoded — enough surface for external tooling parity without
-bringing in a web stack.
+bringing in a web stack.  The standard Beacon API families
+(beacon/states, headers, blocks, pool, config, validator duties,
+debug, events) route into ``beacon_api.BeaconAPI``; ``/eth/v1/events``
+is a Server-Sent-Events stream off the node's event feed — the
+streaming-subscription analog of the reference's gRPC server streams.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 _backup_seq = itertools.count()
 
+from ..blockchain.events import EVENT_BLOCK, EVENT_FINALIZED, EVENT_HEAD
 from ..proto import Attestation
 from .api import APIError
+from .beacon_api import BeaconAPI
 
 # malformed client input (missing params, bad hex/SSZ, bad slot) maps
 # to 400 per Beacon-API convention; anything else is a true 500
 _CLIENT_ERRORS = (KeyError, ValueError, APIError, json.JSONDecodeError)
+
+
+def _body_ssz(body) -> bytes:
+    """POST bodies carry SSZ as hex; accept both bare and 0x-prefixed
+    (the GET endpoints emit 0x-prefixed, so GET output must POST back
+    verbatim)."""
+    return bytes.fromhex(body["ssz"].removeprefix("0x"))
+
+
+def _jsonable(obj):
+    """Event payloads may carry raw roots — hex them for the wire."""
+    if isinstance(obj, bytes):
+        return "0x" + obj.hex()
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    return obj
 
 
 class BeaconHTTPServer:
@@ -32,6 +57,7 @@ class BeaconHTTPServer:
                  port: int = 0):
         self.node = node
         self.api = api
+        self.beacon = BeaconAPI(node, validator_api=api)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -73,6 +99,8 @@ class BeaconHTTPServer:
     def _handle_get(self, h) -> None:
         path, _, query = h.path.partition("?")
         params = dict(kv.split("=", 1) for kv in query.split("&") if "=" in kv)
+        parts = [p for p in path.split("/") if p]
+        b = self.beacon
         if path == "/eth/v1/node/health":
             h._send(200, self.api.node_health())
         elif path == "/metrics":
@@ -89,9 +117,6 @@ class BeaconHTTPServer:
                 "target": {"epoch": data.target.epoch,
                            "root": data.target.root.hex()},
             })
-        elif path == "/eth/v1/beacon/headers/head":
-            root, state = self.node.chain.head()
-            h._send(200, {"root": root.hex(), "slot": state.slot})
         elif path == "/eth/v1/node/version":
             h._send(200, {"data": {"version": "prysm_tpu/0.2"}})
         elif path == "/eth/v1/node/syncing":
@@ -103,19 +128,163 @@ class BeaconHTTPServer:
                 "sync_distance": max(0, current - head),
                 "is_syncing": current > head + 1,
             }})
+        elif path == "/eth/v1/beacon/genesis":
+            h._send(200, b.genesis())
+        # /eth/v1/beacon/states/{state_id}/...
+        elif (len(parts) >= 6 and parts[:3] == ["eth", "v1", "beacon"]
+              and parts[3] == "states"):
+            sid, tail = parts[4], parts[5]
+            if tail == "root":
+                h._send(200, b.state_root(sid))
+            elif tail == "fork":
+                h._send(200, b.state_fork(sid))
+            elif tail == "finality_checkpoints":
+                h._send(200, b.finality_checkpoints(sid))
+            elif tail == "validators" and len(parts) == 7:
+                h._send(200, b.validator(sid, parts[6]))
+            elif tail == "validators":
+                ids = params.get("id")
+                statuses = params.get("status")
+                h._send(200, b.validators(
+                    sid, ids.split(",") if ids else None,
+                    statuses.split(",") if statuses else None))
+            elif tail == "validator_balances":
+                ids = params.get("id")
+                h._send(200, b.validator_balances(
+                    sid, ids.split(",") if ids else None))
+            elif tail == "committees":
+                h._send(200, b.committees(
+                    sid,
+                    epoch=(int(params["epoch"])
+                           if "epoch" in params else None),
+                    index=(int(params["index"])
+                           if "index" in params else None),
+                    slot=(int(params["slot"])
+                          if "slot" in params else None)))
+            else:
+                h._send(404, {"error": f"no route {path}"})
+        elif path == "/eth/v1/beacon/headers":
+            h._send(200, b.headers(
+                slot=(int(params["slot"]) if "slot" in params
+                      else None),
+                parent_root=(bytes.fromhex(
+                    params["parent_root"].removeprefix("0x"))
+                    if "parent_root" in params else None)))
+        elif (len(parts) == 5 and parts[:4] == ["eth", "v1", "beacon",
+                                                "headers"]):
+            h._send(200, b.header(parts[4]))
+        elif (len(parts) == 5 and parts[:4] == ["eth", "v2", "beacon",
+                                                "blocks"]):
+            ssz_bytes, root = b.block_ssz(parts[4])
+            h._send(200, {"root": "0x" + root.hex(),
+                          "ssz": ssz_bytes.hex()})
+        elif (len(parts) == 6 and parts[:4] == ["eth", "v1", "beacon",
+                                                "blocks"]
+              and parts[5] == "root"):
+            h._send(200, b.block_root(parts[4]))
+        elif (len(parts) == 6 and parts[:4] == ["eth", "v1", "beacon",
+                                                "blocks"]
+              and parts[5] == "attestations"):
+            h._send(200, b.block_attestations(parts[4]))
+        elif path == "/eth/v1/beacon/pool/attestations":
+            h._send(200, b.pool_attestations())
+        elif path == "/eth/v1/beacon/pool/attester_slashings":
+            h._send(200, b.pool_attester_slashings())
+        elif path == "/eth/v1/beacon/pool/proposer_slashings":
+            h._send(200, b.pool_proposer_slashings())
+        elif path == "/eth/v1/beacon/pool/voluntary_exits":
+            h._send(200, b.pool_voluntary_exits())
+        elif path == "/eth/v1/config/spec":
+            h._send(200, b.spec())
+        elif path == "/eth/v1/config/fork_schedule":
+            h._send(200, b.fork_schedule())
+        elif path == "/eth/v1/config/deposit_contract":
+            h._send(200, b.deposit_contract())
+        elif (len(parts) == 6 and parts[:5] == ["eth", "v1",
+                                                "validator", "duties",
+                                                "proposer"]):
+            h._send(200, b.proposer_duties(int(parts[5])))
+        elif path == "/eth/v1/debug/beacon/heads":
+            h._send(200, b.debug_heads())
+        elif path == "/eth/v1/debug/fork_choice":
+            h._send(200, b.debug_forkchoice())
+        elif path == "/eth/v1/events":
+            self._handle_events(h, params)
         else:
             h._send(404, {"error": f"no route {path}"})
+
+    # --- SSE event stream ---------------------------------------------------
+
+    _EVENT_TOPICS = {"head": EVENT_HEAD, "block": EVENT_BLOCK,
+                     "finalized_checkpoint": EVENT_FINALIZED}
+
+    def _handle_events(self, h, params) -> None:
+        """Server-Sent Events: subscribe the connection to the node's
+        event feed and stream until the client disconnects (the
+        reference's gRPC StreamEvents analog)."""
+        topics = [t for t in params.get("topics", "head").split(",")
+                  if t in self._EVENT_TOPICS]
+        if not topics:
+            h._send(400, {"error": "no valid topics"})
+            return
+        q: "queue.Queue[tuple[str, dict]]" = queue.Queue(maxsize=256)
+        subs = []
+        for t in topics:
+            def put(payload, _t=t):
+                try:
+                    q.put_nowait((_t, payload))
+                except queue.Full:
+                    pass
+            self.node.events.subscribe(self._EVENT_TOPICS[t], put)
+            subs.append((self._EVENT_TOPICS[t], put))
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "text/event-stream")
+            h.send_header("Cache-Control", "no-cache")
+            h.end_headers()
+            while not getattr(self, "_shutdown", False):
+                try:
+                    topic, payload = q.get(timeout=1.0)
+                except queue.Empty:
+                    h.wfile.write(b":keep-alive\n\n")  # comment ping
+                    h.wfile.flush()
+                    continue
+                body = json.dumps(_jsonable(payload))
+                h.wfile.write(
+                    f"event: {topic}\ndata: {body}\n\n".encode())
+                h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            for ev, fn in subs:
+                self.node.events.unsubscribe(ev, fn)
 
     def _handle_post(self, h) -> None:
         length = int(h.headers.get("Content-Length", 0))
         body = json.loads(h.rfile.read(length) or b"{}")
-        if h.path == "/eth/v1/beacon/blocks":
-            raw = bytes.fromhex(body["ssz"])
+        parts = [p for p in h.path.split("/") if p]
+        if (len(parts) == 6 and parts[:5] == ["eth", "v1", "validator",
+                                              "duties", "attester"]):
+            h._send(200, self.beacon.attester_duties(
+                int(parts[5]), [int(i) for i in body]))
+        elif h.path == "/eth/v1/beacon/pool/voluntary_exits":
+            self.beacon.submit_voluntary_exit(_body_ssz(body))
+            h._send(200, {"ok": True})
+        elif h.path == "/eth/v1/beacon/pool/attester_slashings":
+            self.beacon.submit_attester_slashing(
+                _body_ssz(body))
+            h._send(200, {"ok": True})
+        elif h.path == "/eth/v1/beacon/pool/proposer_slashings":
+            self.beacon.submit_proposer_slashing(
+                _body_ssz(body))
+            h._send(200, {"ok": True})
+        elif h.path == "/eth/v1/beacon/blocks":
+            raw = _body_ssz(body)
             signed = self.node.types.SignedBeaconBlock.deserialize(raw)
             root = self.api.submit_block(signed)
             h._send(200, {"root": root.hex()})
         elif h.path == "/eth/v1/beacon/pool/attestations":
-            raw = bytes.fromhex(body["ssz"])
+            raw = _body_ssz(body)
             att = Attestation.deserialize(raw)
             self.api.submit_attestation(att)
             h._send(200, {"ok": True})
@@ -142,6 +311,7 @@ class BeaconHTTPServer:
         self._thread.start()
 
     def stop(self) -> None:
+        self._shutdown = True        # ends any open SSE streams <=1s
         self._server.shutdown()
         self._server.server_close()
         if self._thread:

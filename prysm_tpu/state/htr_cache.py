@@ -54,6 +54,8 @@ _VECTOR_FIELDS = ("block_roots", "state_roots", "randao_mixes",
 # tracked lineages kept per list field (head + fork + scratch); each
 # validators trie at 500k is ~32 MB, so the cap bounds memory
 _MAX_LINEAGES = int(os.environ.get("PRYSM_HTR_LINEAGES", "3"))
+# promote-on-second-root memory (uids rooted once, two ints each)
+_SEEN_ONCE_WINDOW = int(os.environ.get("PRYSM_HTR_SEEN_WINDOW", "1024"))
 
 
 def _pack_u64(values) -> np.ndarray:
@@ -128,6 +130,11 @@ class StateHTRCache:
         self.cls = cls
         self._tries: dict[str, FieldTrie] = {}        # vector fields
         self._lineages: dict[str, OrderedDict[int, _Lineage]] = {}
+        # uids rooted exactly once: a list is PROMOTED to a tracked
+        # lineage only on its second root, so one-shot states (API
+        # reads resolving fresh copies, replay scratch states) never
+        # evict the hot head/fork lineages out of the LRU
+        self._seen_once: dict[str, OrderedDict[int, None]] = {}
         self._lock = threading.Lock()
 
     def root(self, state) -> bytes:
@@ -287,6 +294,17 @@ class StateHTRCache:
         entry.elem_len = len(value)
         return trie
 
+    def _untagged_leaves(self, name, typ, value) -> np.ndarray:
+        """Leaf rows with NO ownership tagging (one-shot roots and
+        aliased lineages, whose hints are never consulted)."""
+        if name == "validators":
+            htr = typ.elem.hash_tree_root
+            leaves = np.empty((len(value), 32), dtype=np.uint8)
+            for i, v in enumerate(value):
+                leaves[i] = np.frombuffer(htr(v), dtype=np.uint8)
+            return leaves
+        return _pack_u64(value)
+
     def _full_resync(self, name, typ, value, entry: _Lineage) -> None:
         """Rebuild the lineage from the current leaf array (numpy diff
         against any existing trie), tagging every validator this
@@ -299,11 +317,9 @@ class StateHTRCache:
             htr = typ.elem.hash_tree_root
             leaves = np.empty((len(value), 32), dtype=np.uint8)
             if entry.aliased:
-                # hints are never consulted again: plain leaf loop,
-                # no tagging (and no ownership claims that would
-                # downgrade other lineages)
-                for i, v in enumerate(value):
-                    leaves[i] = np.frombuffer(htr(v), dtype=np.uint8)
+                # hints are never consulted again: no ownership
+                # claims that would downgrade other lineages
+                leaves = self._untagged_leaves(name, typ, value)
             else:
                 dlog = entry.dlog
                 seen: set[int] = set()
@@ -337,6 +353,13 @@ class StateHTRCache:
         value.drain()
         entry.elem_len = len(value)
 
+    def _ladder_root(self, name: str, trie: FieldTrie,
+                     length: int) -> bytes:
+        node = trie.vector_root()
+        for level in range(trie.depth, _LIST_DEPTH[name]):
+            node = _hash2(node, ZERO_HASHES[level])
+        return mix_in_length(node, length)
+
     def _list_root(self, name: str, typ, value, state) -> bytes:
         if not isinstance(value, TrackedList):
             value = TrackedList(value)
@@ -349,17 +372,30 @@ class StateHTRCache:
             if trie is None:
                 self._full_resync(name, typ, value, entry)
         else:
+            seen = self._seen_once.setdefault(name, OrderedDict())
+            if value.uid not in seen:
+                # first sight: one-shot root, no lineage slot taken.
+                # The window must comfortably exceed any plausible
+                # one-shot churn between two roots of a genuinely hot
+                # state, else that state can never promote (review
+                # r4); entries are two ints each, so generous is cheap
+                seen[value.uid] = None
+                while len(seen) > _SEEN_ONCE_WINDOW:
+                    seen.popitem(last=False)
+                leaves = self._untagged_leaves(name, typ, value)
+                value.drain()
+                trie = FieldTrie.from_array(leaves,
+                                            _next_pow2(leaves.shape[0]))
+                return self._ladder_root(name, trie, len(value))
+            # second root of the same list: promote to a lineage
+            seen.pop(value.uid, None)
             entry = _Lineage()
             self._full_resync(name, typ, value, entry)
             lineages[value.uid] = entry
             while len(lineages) > _MAX_LINEAGES:
                 _, evicted = lineages.popitem(last=False)
                 evicted.retire()
-        trie = entry.trie
-        node = trie.vector_root()
-        for level in range(trie.depth, _LIST_DEPTH[name]):
-            node = _hash2(node, ZERO_HASHES[level])
-        return mix_in_length(node, len(value))
+        return self._ladder_root(name, entry.trie, len(value))
 
     def _vector_root(self, name: str, typ, value) -> bytes:
         leaves = _leaf_array(name, typ, value)

@@ -76,6 +76,21 @@ def main(argv=None) -> int:
     pt.add_argument("pre_state")
     pt.add_argument("block")
     pt.add_argument("--no-verify-signatures", action="store_true")
+    pe = sub.add_parser("record",
+                        help="create a signed node record (ENR analog)")
+    pe.add_argument("--key-index", type=int, default=0,
+                    help="deterministic key index to sign with")
+    pe.add_argument("--host", default="127.0.0.1")
+    pe.add_argument("--port", type=int, required=True)
+    pe.add_argument("--seq", type=int, default=1)
+    pd = sub.add_parser("record-decode",
+                        help="verify + pretty-print a pnr: record")
+    pd.add_argument("record")
+    pb = sub.add_parser("bootnode",
+                        help="run a node-record directory service")
+    pb.add_argument("--host", default="127.0.0.1")
+    pb.add_argument("--port", type=int, default=0)
+    pb.add_argument("--ttl", type=float, default=600.0)
     args = p.parse_args(argv)
 
     if args.cmd in ("pretty", "htr"):
@@ -111,6 +126,45 @@ def main(argv=None) -> int:
             verify_signatures=not args.no_verify_signatures)
         root = types.BeaconState.hash_tree_root(state)
         print(f"post-state slot={state.slot} root=0x{root.hex()}")
+        return 0
+
+    if args.cmd == "record":
+        from ..crypto.bls import bls
+        from ..p2p.discovery import NodeRecord
+
+        sk, _pk = bls.deterministic_keypair(args.key_index)
+        rec = NodeRecord.create(sk, args.host, args.port, seq=args.seq)
+        print(rec.encode())
+        return 0
+
+    if args.cmd == "record-decode":
+        from ..p2p.discovery import NodeRecord, RecordError
+
+        try:
+            rec = NodeRecord.decode(args.record)
+        except RecordError as e:
+            print(f"INVALID: {e}")
+            return 1
+        print(f"node_id={rec.node_id}")
+        print(f"host={rec.host} port={rec.port} seq={rec.seq}")
+        print(f"fork_digest=0x{rec.fork_digest.hex()}")
+        print(f"pubkey=0x{rec.pubkey.hex()}")
+        return 0
+
+    if args.cmd == "bootnode":
+        import time as _time
+
+        from ..p2p.discovery import Bootnode
+
+        bn = Bootnode(args.host, args.port, ttl=args.ttl)
+        bn.start()
+        print(f"bootnode listening on {args.host}:{bn.port}",
+              flush=True)
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            bn.stop()
         return 0
     return 1
 

@@ -1,0 +1,259 @@
+"""Standard Beacon API surface tests (rpc/beacon_api.py + the HTTP
+routes): states, validators, committees, headers, blocks, pool,
+config, duties, debug, and the SSE event stream.
+
+Reference analog: ``beacon-chain/rpc/eth/`` handlers [U, SURVEY.md §2
+"RPC"]."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from prysm_tpu.config import use_mainnet_config, use_minimal_config
+from prysm_tpu.p2p import GossipBus
+from prysm_tpu.proto import build_types
+from prysm_tpu.rpc import BeaconHTTPServer, ValidatorAPI
+from prysm_tpu.rpc.api import APIError
+from prysm_tpu.rpc.beacon_api import BeaconAPI
+from prysm_tpu.testing import util as testutil
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_config():
+    use_minimal_config()
+    yield
+    use_mainnet_config()
+
+
+@pytest.fixture(scope="module")
+def types():
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    return build_types(MINIMAL_CONFIG)
+
+
+@pytest.fixture()
+def node(types):
+    from prysm_tpu.node import BeaconNode
+
+    genesis = testutil.deterministic_genesis_state(16, types)
+    bus = GossipBus()
+    n = BeaconNode(bus, "beacon-api-node", genesis, types=types)
+    yield n
+    n.stop()
+
+
+@pytest.fixture()
+def advanced_node(node, types):
+    """Node with two real blocks applied (signatures verified)."""
+    from prysm_tpu.core.transition import state_transition
+
+    st = node.chain.stategen.state_by_root(node.chain.head_root)
+    for slot in (1, 2):
+        blk = testutil.generate_full_block(st, slot=slot)
+        node.chain.receive_block(blk)
+        state_transition(st, blk, types, verify_signatures=False)
+    return node
+
+
+class TestStates:
+    def test_genesis(self, node):
+        b = BeaconAPI(node)
+        g = b.genesis()["data"]
+        assert g["genesis_validators_root"].startswith("0x")
+        assert int(g["genesis_time"]) > 0
+
+    def test_state_root_matches_htr(self, node, types):
+        b = BeaconAPI(node)
+        got = b.state_root("head")["data"]["root"]
+        st = node.chain.head_state
+        assert got == "0x" + types.BeaconState.hash_tree_root(st).hex()
+
+    def test_state_ids(self, advanced_node):
+        b = BeaconAPI(advanced_node)
+        assert b.state_root("head") == b.state_root("2")
+        assert b.state_root("genesis") == b.state_root("0")
+        # fork + finality checkpoints resolve on every id
+        for sid in ("head", "genesis", "finalized", "justified"):
+            assert "current_version" in b.state_fork(sid)["data"]
+            assert "finalized" in b.finality_checkpoints(sid)["data"]
+
+    def test_unknown_state(self, node):
+        with pytest.raises(APIError):
+            BeaconAPI(node).resolve_state("0x" + "ab" * 32)
+
+
+class TestValidators:
+    def test_all_validators(self, node):
+        b = BeaconAPI(node)
+        vs = b.validators("head")["data"]
+        assert len(vs) == 16
+        assert all(v["status"] == "active_ongoing" for v in vs)
+
+    def test_by_index_and_pubkey(self, node):
+        b = BeaconAPI(node)
+        v3 = b.validator("head", "3")["data"]
+        assert v3["index"] == "3"
+        again = b.validator("head", v3["validator"]["pubkey"])["data"]
+        assert again == v3
+
+    def test_status_filter_and_balances(self, node):
+        b = BeaconAPI(node)
+        assert b.validators("head",
+                            statuses=["exited_slashed"])["data"] == []
+        bals = b.validator_balances("head", ["0", "5"])["data"]
+        assert [x["index"] for x in bals] == ["0", "5"]
+        assert all(int(x["balance"]) > 0 for x in bals)
+
+    def test_committees_cover_epoch(self, node):
+        b = BeaconAPI(node)
+        data = b.committees("head", epoch=0)["data"]
+        members = [int(v) for c in data for v in c["validators"]]
+        assert sorted(members) == list(range(16))
+        one_slot = b.committees("head", epoch=0,
+                                slot=int(data[0]["slot"]))["data"]
+        assert all(c["slot"] == data[0]["slot"] for c in one_slot)
+
+
+class TestHeadersBlocks:
+    def test_header_and_roots(self, advanced_node, types):
+        b = BeaconAPI(advanced_node)
+        hd = b.header("head")["data"]
+        assert hd["canonical"] is True
+        assert hd["header"]["message"]["slot"] == "2"
+        assert b.block_root("head")["data"]["root"] == hd["root"]
+        # round-trip the SSZ block
+        ssz_bytes, root = b.block_ssz("head")
+        blk = types.SignedBeaconBlock.deserialize(ssz_bytes)
+        assert blk.message.slot == 2
+        # by-slot id resolves the same block
+        assert b.block_root("2")["data"]["root"] == hd["root"]
+
+    def test_headers_by_slot_and_parent(self, advanced_node):
+        b = BeaconAPI(advanced_node)
+        h1 = b.headers(slot=1)["data"]
+        assert len(h1) == 1 and h1[0]["header"]["message"]["slot"] == "1"
+        kids = b.headers(parent_root=bytes.fromhex(
+            h1[0]["root"][2:]))["data"]
+        assert [k["header"]["message"]["slot"] for k in kids] == ["2"]
+
+    def test_block_attestations_listed(self, advanced_node):
+        b = BeaconAPI(advanced_node)
+        atts = b.block_attestations("2")["data"]
+        assert isinstance(atts, list)   # slot-2 block may carry atts
+
+
+class TestPoolAndConfig:
+    def test_pool_endpoints_empty(self, node):
+        b = BeaconAPI(node)
+        assert b.pool_attestations()["data"] == []
+        assert b.pool_attester_slashings()["data"] == []
+        assert b.pool_proposer_slashings()["data"] == []
+        assert b.pool_voluntary_exits()["data"] == []
+
+    def test_spec_and_fork_schedule(self, node):
+        b = BeaconAPI(node)
+        spec = b.spec()["data"]
+        assert spec["SLOTS_PER_EPOCH"] == "8"     # minimal preset
+        assert b.fork_schedule()["data"][0]["epoch"] == "0"
+
+
+class TestDuties:
+    def test_proposer_duties(self, node):
+        b = BeaconAPI(node)
+        duties = b.proposer_duties(0)["data"]
+        # minimal preset: slots 1..7 of epoch 0 have proposers
+        assert len(duties) == 7
+        assert all(int(d["validator_index"]) < 16 for d in duties)
+
+    def test_attester_duties(self, node):
+        b = BeaconAPI(node)
+        out = b.attester_duties(0, [0, 1, 2])["data"]
+        assert {d["validator_index"] for d in out} <= {"0", "1", "2"}
+        assert all(0 <= int(d["slot"]) < 8 for d in out)
+
+
+class TestDebug:
+    def test_heads_and_forkchoice(self, advanced_node):
+        b = BeaconAPI(advanced_node)
+        heads = b.debug_heads()["data"]
+        assert len(heads) == 1 and heads[0]["slot"] == "2"
+        fc = b.debug_forkchoice()["data"]
+        assert len(fc) == 3                      # genesis + 2 blocks
+        assert fc[0]["parent_root"] is None
+
+
+class TestHTTPRoutes:
+    def test_get_routes_and_sse(self, advanced_node):
+        api = ValidatorAPI(advanced_node)
+        srv = BeaconHTTPServer(advanced_node, api)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    return json.load(r)
+
+            assert "data" in get("/eth/v1/beacon/genesis")
+            assert get("/eth/v1/beacon/states/head/root")[
+                "data"]["root"].startswith("0x")
+            assert len(get("/eth/v1/beacon/states/head/validators")
+                       ["data"]) == 16
+            assert get("/eth/v1/beacon/states/head/validators/0")[
+                "data"]["index"] == "0"
+            assert get("/eth/v1/beacon/states/head/committees?epoch=0"
+                       )["data"]
+            assert get("/eth/v1/beacon/headers")["data"][0][
+                "canonical"]
+            assert get("/eth/v2/beacon/blocks/head")["ssz"]
+            assert get("/eth/v1/beacon/pool/attestations")[
+                "data"] == []
+            assert get("/eth/v1/config/spec")["data"][
+                "SLOTS_PER_EPOCH"] == "8"
+            assert get("/eth/v1/validator/duties/proposer/0")["data"]
+            assert get("/eth/v1/debug/beacon/heads")["data"]
+            # POST attester duties
+            req = urllib.request.Request(
+                base + "/eth/v1/validator/duties/attester/0",
+                data=json.dumps([0, 1]).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert json.load(r)["dependent_root"].startswith("0x")
+            # 404 + 400 paths
+            for path, code in [("/eth/v1/nope", 404),
+                               ("/eth/v1/beacon/states/zzz/root", 400)]:
+                try:
+                    urllib.request.urlopen(base + path, timeout=10)
+                    raise AssertionError("expected HTTPError")
+                except urllib.error.HTTPError as e:
+                    assert e.code == code
+
+            # SSE: subscribe, then publish a head event through the
+            # node's feed and read it back off the stream
+            got = {}
+
+            def reader():
+                req = urllib.request.Request(
+                    base + "/eth/v1/events?topics=head")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    buf = b""
+                    while b"\n\n" not in buf or b"event:" not in buf:
+                        buf += r.read1(256)
+                    got["raw"] = buf.decode()
+
+            t = threading.Thread(target=reader)
+            t.start()
+            import time as _time
+
+            _time.sleep(0.3)        # let the subscription register
+            advanced_node.events.publish(
+                "head", {"slot": 2,
+                         "block": advanced_node.chain.head_root})
+            t.join(timeout=10)
+            assert not t.is_alive()
+            assert "event: head" in got["raw"]
+            assert "0x" in got["raw"]
+        finally:
+            srv.stop()

@@ -1,0 +1,174 @@
+"""Discovery layer: signed node records (ENR analog) + bootnode
+directory + transport integration.
+
+Reference analog: discv5 ENRs, ``tools/bootnode`` and
+``tools/enr-calculator`` [U, SURVEY.md §2 "p2p", "tools"]."""
+
+import pytest
+
+from prysm_tpu.config import set_features
+from prysm_tpu.crypto.bls import bls
+from prysm_tpu.p2p.discovery import (
+    Bootnode, NodeRecord, RecordError, lookup, register,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def pure_bls():
+    set_features(bls_implementation="pure")
+    yield
+    set_features(bls_implementation="pure")
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return [bls.deterministic_keypair(i)[0] for i in range(3)]
+
+
+class TestNodeRecord:
+    def test_round_trip(self, keys):
+        rec = NodeRecord.create(keys[0], "10.0.0.7", 9000, seq=3)
+        wire = rec.encode()
+        assert wire.startswith("pnr:")
+        back = NodeRecord.decode(wire)
+        assert back == rec
+        assert back.node_id == rec.node_id
+        assert len(back.node_id) == 40      # 20 bytes hex
+
+    def test_tampered_port_rejected(self, keys):
+        import base64
+
+        rec = NodeRecord.create(keys[0], "10.0.0.7", 9000)
+        raw = bytearray(base64.urlsafe_b64decode(
+            rec.encode()[4:] + "=" * (-len(rec.encode()[4:]) % 4)))
+        raw[144 + 8] ^= 0x01                # flip a port bit
+        forged = "pnr:" + base64.urlsafe_b64encode(
+            bytes(raw)).decode().rstrip("=")
+        with pytest.raises(RecordError):
+            NodeRecord.decode(forged)
+
+    def test_wrong_key_signature_rejected(self, keys):
+        a = NodeRecord.create(keys[0], "h", 1)
+        b = NodeRecord.create(keys[1], "h", 1)
+        import dataclasses
+
+        mixed = dataclasses.replace(a, signature=b.signature)
+        with pytest.raises(RecordError):
+            NodeRecord.decode(mixed.encode())
+
+    def test_garbage_rejected(self):
+        for bad in ("enr:xxxx", "pnr:!!!", "pnr:" + "A" * 10):
+            with pytest.raises(RecordError):
+                NodeRecord.decode(bad)
+
+
+class TestBootnode:
+    def test_register_and_lookup(self, keys):
+        bn = Bootnode()
+        bn.start()
+        try:
+            recs = [NodeRecord.create(k, "127.0.0.1", 9000 + i)
+                    for i, k in enumerate(keys)]
+            for r in recs:
+                register("127.0.0.1", bn.port, r)
+            got = lookup("127.0.0.1", bn.port)
+            assert {r.node_id for r in got} == {r.node_id for r in recs}
+        finally:
+            bn.stop()
+
+    def test_seq_supersedes(self, keys):
+        bn = Bootnode()
+        bn.start()
+        try:
+            old = NodeRecord.create(keys[0], "127.0.0.1", 9000, seq=1)
+            new = NodeRecord.create(keys[0], "127.0.0.1", 9100, seq=2)
+            register("127.0.0.1", bn.port, old)
+            register("127.0.0.1", bn.port, new)
+            register("127.0.0.1", bn.port, old)   # stale: ignored
+            got = lookup("127.0.0.1", bn.port)
+            assert len(got) == 1 and got[0].port == 9100
+        finally:
+            bn.stop()
+
+    def test_forged_registration_rejected(self, keys):
+        import dataclasses
+
+        bn = Bootnode()
+        bn.start()
+        try:
+            a = NodeRecord.create(keys[0], "127.0.0.1", 9000)
+            forged = dataclasses.replace(a, port=9999)
+            with pytest.raises(RecordError):
+                register("127.0.0.1", bn.port, forged)
+            assert lookup("127.0.0.1", bn.port) == []
+        finally:
+            bn.stop()
+
+    def test_ttl_expiry(self, keys):
+        import time
+
+        bn = Bootnode(ttl=0.05)
+        bn.start()
+        try:
+            register("127.0.0.1", bn.port,
+                     NodeRecord.create(keys[0], "127.0.0.1", 9000))
+            assert len(lookup("127.0.0.1", bn.port)) == 1
+            time.sleep(0.1)
+            assert lookup("127.0.0.1", bn.port) == []
+        finally:
+            bn.stop()
+
+
+class TestPcli:
+    def test_record_commands(self, capsys):
+        from prysm_tpu.tools.pcli import main
+
+        assert main(["record", "--port", "9000",
+                     "--key-index", "2"]) == 0
+        wire = capsys.readouterr().out.strip()
+        assert main(["record-decode", wire]) == 0
+        out = capsys.readouterr().out
+        assert "port=9000" in out and "node_id=" in out
+        assert main(["record-decode", "pnr:AAAA"]) == 1
+
+
+class TestDiscoveredTransport:
+    def test_bridges_discover_and_gossip(self, keys):
+        """End-to-end: two processes' worth of buses find each other
+        via the bootnode and relay gossip over the discovered
+        address."""
+        from prysm_tpu.p2p import GossipBus
+        from prysm_tpu.p2p.bus import Verdict
+        from prysm_tpu.p2p.transport import TCPBridge
+
+        bn = Bootnode()
+        bn.start()
+        bus_a, bus_b = GossipBus(), GossipBus()
+        a = TCPBridge(bus_a, "bridge-a", ["blocks"])
+        b = TCPBridge(bus_b, "bridge-b", ["blocks"])
+        try:
+            port_a = a.listen()
+            register("127.0.0.1", bn.port,
+                     NodeRecord.create(keys[0], "127.0.0.1", port_a))
+            # b discovers a through the directory and dials it
+            recs = lookup("127.0.0.1", bn.port)
+            assert len(recs) == 1
+            b.connect(recs[0].host, recs[0].port)
+            assert a.wait_connected()
+
+            got = []
+            peer = bus_a.join("listener")
+            peer.subscribe("blocks", lambda f, d: (
+                got.append(d), Verdict.ACCEPT)[1])
+            sender = bus_b.join("sender")
+            sender.broadcast("blocks", b"\x01\x02\x03")
+            import time
+
+            deadline = time.monotonic() + 5
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert got == [b"\x01\x02\x03"]
+        finally:
+            a.close()
+            b.close()
+            bn.stop()

@@ -105,8 +105,9 @@ def test_fork_lineages_both_incremental(genesis):
     # every root (each keeps its own trie; no ping-pong full rebuilds)
     a = genesis.copy()
     b = a.copy()
-    _check(a)
-    _check(b)
+    for _ in range(2):          # second root promotes to a lineage
+        _check(a)
+        _check(b)
     entry_a, entry_b = _lineage(a), _lineage(b)
     assert entry_a is not None and entry_b is not None
     trie_a, trie_b = entry_a.trie, entry_b.trie
@@ -182,6 +183,7 @@ def test_append_then_setitem_not_false_aliased(genesis):
     # dirty set and the growth range — must not false-flag aliasing
     state = genesis.copy()
     _check(state)
+    _check(state)               # promote to a tracked lineage
     v = state.validators[0].copy()
     v.pubkey = b"\x55" * 48
     state.validators.append(v)
@@ -197,14 +199,32 @@ def test_lru_evicted_lineage_reclaims_incremental(genesis):
     # reclaimable — the re-admitted state regains the O(changed) path
     states = [genesis.copy() for _ in range(htr_cache._MAX_LINEAGES + 1)]
     for s in states:
-        _check(s)                  # last admit evicts states[0]
+        _check(s)
+        _check(s)                  # 2nd root promotes; last evicts [0]
     assert _lineage(states[0]) is None
+    _check(states[0])              # seen-once again
     _check(states[0])              # re-admit: full resync reclaims tags
     entry = _lineage(states[0])
     assert entry is not None and not entry.aliased
     states[0].validators[3].exit_epoch = 55
     _check(states[0])
     assert not entry.aliased       # stayed on the incremental path
+
+
+def test_one_shot_roots_do_not_evict_lineages(genesis):
+    # hardening r4: API-style one-shot roots (fresh copies rooted
+    # once) must not steal tracked lineage slots from the hot states
+    hot = genesis.copy()
+    _check(hot)
+    _check(hot)                    # promoted
+    entry = _lineage(hot)
+    assert entry is not None
+    for _ in range(htr_cache._MAX_LINEAGES + 2):
+        _check(genesis.copy())     # one-shot each: no lineage taken
+    assert _lineage(hot) is entry  # hot lineage survived
+    hot.validators[1].exit_epoch = 9
+    _check(hot)
+    assert not entry.aliased
 
 
 def test_alias_detected_at_full_rebuild():

@@ -172,7 +172,7 @@ class TestHTTPGateway:
             with urllib.request.urlopen(
                     f"{base}/eth/v1/beacon/headers/head") as r:
                 head = json.load(r)
-            assert head["slot"] == 0
+            assert head["data"]["header"]["message"]["slot"] == "0"
 
             # propose a real block over HTTP
             km = KeyManager.deterministic(16)
