@@ -93,6 +93,17 @@ class BeaconChainConfig:
     deposit_network_id: int = 1
     deposit_contract_tree_depth: int = 32
 
+    # Shard chains (Phore "Synapse" analog — SURVEY.md §2 row 38;
+    # the reference mount is empty, so shapes follow the public
+    # phase-0 v0.8.x crosslink spec the fork era derives from).
+    # Inert unless features().shard_chains is set: no phase-0
+    # container or state root changes.
+    shard_count: int = 64
+    max_epochs_per_crosslink: int = 64
+    max_shard_block_size: int = 2 ** 16
+    domain_shard_proposer: bytes = b"\x80\x00\x00\x00"
+    domain_shard_attester: bytes = b"\x81\x00\x00\x00"
+
     def slots_per_eth1_voting_period(self) -> int:
         return self.epochs_per_eth1_voting_period * self.slots_per_epoch
 
@@ -120,6 +131,8 @@ MINIMAL_CONFIG = dataclasses.replace(
     inactivity_penalty_quotient=2**25,
     min_slashing_penalty_quotient=64,
     proportional_slashing_multiplier=2,
+    shard_count=8,
+    max_epochs_per_crosslink=4,
 )
 
 _active_config: BeaconChainConfig = MAINNET_CONFIG
@@ -187,6 +200,7 @@ class FeatureFlags:
     bls_implementation: str = "pure"
     enable_tracing: bool = False
     slot_batch_verify: bool = True
+    shard_chains: bool = False
     extra: dict = field(default_factory=dict)
 
 

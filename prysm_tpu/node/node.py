@@ -73,11 +73,21 @@ class BeaconNode:
         self.ticker = SlotTicker(genesis_state.genesis_time,
                                  self._on_slot, time_fn=time_fn)
 
+        # Phore Synapse analog (SURVEY §2 row 38): shard chains +
+        # crosslink sidecar, only when the feature flag is on
+        self.shards = None
+        if features().shard_chains:
+            from ..shard import ShardService
+
+            self.shards = ShardService(genesis_root)
+
         # registration order IS dependency order
         self.registry.register("db", _NullService(self.db))
         self.registry.register("stategen", _NullService(self.stategen))
         self.registry.register("blockchain", _NullService(self.chain))
         self.registry.register("sync", self.sync)
+        if self.shards is not None:
+            self.registry.register("shard", self.shards)
         self.registry.register("ticker", self.ticker)
 
     # --- lifecycle ---------------------------------------------------------
@@ -105,6 +115,11 @@ class BeaconNode:
                                  time.perf_counter() - t0)
             if not ok:
                 self.metrics.inc("slot_batch_failures")
+        if (self.shards is not None and slot > 0
+                and slot % cfg.slots_per_epoch == 0):
+            # epoch boundary: advance the crosslink sidecar from the
+            # head state's attestation view
+            self.shards.on_epoch_boundary(self.chain.head_state)
         retention = cfg.slots_per_epoch
         if slot > retention:
             self.att_pool.prune_before(slot - retention)

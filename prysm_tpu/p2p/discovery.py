@@ -98,7 +98,11 @@ class NodeRecord:
         host_b = payload[struct.calcsize("<QH4sB"):]
         if len(host_b) != hlen:
             raise RecordError("host length mismatch")
-        rec = cls(pubkey=pubkey, host=host_b.decode(), port=port,
+        try:
+            host = host_b.decode()
+        except UnicodeDecodeError as e:
+            raise RecordError(f"bad host encoding: {e}") from None
+        rec = cls(pubkey=pubkey, host=host, port=port,
                   fork_digest=fork_digest, seq=seq, signature=sig)
         try:
             pk = bls.PublicKey.from_bytes(pubkey)

@@ -74,6 +74,14 @@ class BeaconAPI:
                     return chain.stategen.state_by_root(br)
             raise APIError(f"unknown state {state_id}")
         slot = int(state_id)
+        # bound how far past the head a request may advance a state:
+        # an unbounded numeric id would let any client burn hours of
+        # epoch processing (DoS) — the reference serves only
+        # chain-known states
+        horizon = chain.head_slot() + 2 * beacon_config().slots_per_epoch
+        if slot < 0 or slot > horizon:
+            raise APIError(
+                f"slot {slot} beyond the serveable horizon {horizon}")
         anchor = chain.forkchoice.ancestor_at_slot(chain.head_root,
                                                    slot)
         if anchor is not None:
@@ -103,6 +111,12 @@ class BeaconAPI:
             slot = int(block_id)
             root = chain.forkchoice.ancestor_at_slot(chain.head_root,
                                                      slot)
+            # ancestor_at_slot is at-or-before: an empty or future
+            # slot must 404, not alias the previous block (matches
+            # the headers(slot=...) exact-slot semantics)
+            if root is not None and chain.forkchoice.has_node(root) \
+                    and chain.forkchoice.node(root).slot != slot:
+                root = None
             if root is None:
                 raise APIError(f"no canonical block at slot {slot}")
         blk = db.block(root)
@@ -219,7 +233,7 @@ class BeaconAPI:
                     out.append(i)
             else:
                 i = int(vid)
-                if i < len(st.validators):
+                if 0 <= i < len(st.validators):
                     out.append(i)
         return out
 
@@ -254,8 +268,14 @@ class BeaconAPI:
         if epoch is None:
             epoch = get_current_epoch(st)
         start = compute_start_slot_at_epoch(epoch)
+        horizon = (self.node.chain.head_slot()
+                   + 2 * beacon_config().slots_per_epoch)
+        if start > horizon:
+            raise APIError(
+                f"epoch {epoch} beyond the serveable horizon")
         if st.slot < start:
-            st = st.copy()
+            # resolve_state always returns a private copy — advance in
+            # place (no second full-state copy)
             process_slots(st, start, self.node.types)
         count = get_committee_count_per_slot(st, epoch)
         cfg = beacon_config()
@@ -424,7 +444,7 @@ class BeaconAPI:
     def deposit_contract(self) -> dict:
         cfg = beacon_config()
         return {"data": {
-            "chain_id": "1",
+            "chain_id": str(cfg.deposit_chain_id),
             "address": _hex(getattr(cfg, "deposit_contract_address",
                                     b"\x00" * 20)),
         }}
@@ -434,6 +454,11 @@ class BeaconAPI:
     def proposer_duties(self, epoch: int) -> dict:
         chain = self.node.chain
         start = compute_start_slot_at_epoch(epoch)
+        horizon = (chain.head_slot()
+                   + 2 * beacon_config().slots_per_epoch)
+        if epoch < 0 or start > horizon:
+            raise APIError(
+                f"epoch {epoch} beyond the serveable horizon")
         anchor = chain.forkchoice.ancestor_at_slot(chain.head_root,
                                                    start)
         st = chain.stategen.state_by_root(

@@ -1,0 +1,45 @@
+"""Shard chains — the Phore "Synapse" sidecar subsystem.
+
+SURVEY.md §2 row 38: the fork's shard additions are unknowable (the
+reference mount is empty), so this subsystem implements the public
+phase-0 v0.8.x crosslink design that era of Prysm forks derives from:
+per-shard committees, BLS-signed shard blocks, and epoch-boundary
+winning-crosslink selection.  Inert unless ``features().shard_chains``
+is set; the phase-0 beacon containers and state roots are unchanged.
+"""
+
+from .committee import (
+    crosslink_committee_index,
+    get_crosslink_committee,
+    get_epoch_committee_count,
+    get_shard_delta,
+    get_shard_proposer_index,
+    get_start_shard,
+    shard_assignments,
+)
+from .crosslinks import (
+    CrosslinkStore,
+    default_crosslink,
+    get_winning_crosslink_and_attesting_indices,
+    process_crosslinks,
+)
+from .service import ShardService, ShardServiceError, shard_block_topic
+from .types import (
+    Crosslink,
+    CrosslinkAttestation,
+    CrosslinkAttestationData,
+    build_shard_types,
+    shard_block_header,
+)
+
+__all__ = [
+    "Crosslink", "CrosslinkAttestation", "CrosslinkAttestationData",
+    "CrosslinkStore", "ShardService", "ShardServiceError",
+    "build_shard_types", "crosslink_committee_index",
+    "default_crosslink", "get_crosslink_committee",
+    "get_epoch_committee_count", "get_shard_delta",
+    "get_shard_proposer_index", "get_start_shard",
+    "get_winning_crosslink_and_attesting_indices",
+    "process_crosslinks", "shard_assignments", "shard_block_header",
+    "shard_block_topic",
+]

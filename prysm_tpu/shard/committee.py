@@ -1,0 +1,105 @@
+"""Shard committee assignment (Phore "Synapse" analog).
+
+Reference analog: the fork's shard committee machinery [U, SURVEY.md
+§2 row 38].  Semantics follow the public v0.8.x crosslink spec: each
+epoch, the epoch's beacon committees are assigned round-robin to
+shards starting at the epoch's start shard, which rotates by the
+epoch's shard delta so every shard is crosslinked at a steady cadence
+even when there are fewer committees than shards.
+
+Everything here is a pure function of (state, epoch) — cacheable and
+deterministic, reusing the beacon committee cache (one shuffle per
+epoch serves all shards).
+"""
+
+from __future__ import annotations
+
+from ..config import beacon_config
+from ..core import helpers
+
+
+def get_epoch_committee_count(state, epoch: int, cfg=None) -> int:
+    """Committees in the whole epoch (v0.8 get_committee_count)."""
+    cfg = cfg or beacon_config()
+    return (helpers.get_committee_count_per_slot(state, epoch, cfg)
+            * cfg.slots_per_epoch)
+
+
+def get_shard_delta(state, epoch: int, cfg=None) -> int:
+    """How far the start shard rotates per epoch: the number of
+    committees, capped so the rotation never laps the shard ring
+    within one epoch (v0.8 get_shard_delta)."""
+    cfg = cfg or beacon_config()
+    return min(get_epoch_committee_count(state, epoch, cfg),
+               cfg.shard_count - cfg.shard_count // cfg.slots_per_epoch)
+
+
+def get_start_shard(state, epoch: int, cfg=None) -> int:
+    """Start shard for an epoch.
+
+    v0.8 tracked ``state.start_shard`` incrementally; a sidecar module
+    cannot add state fields without changing phase-0 roots, so the
+    start shard is derived statelessly: the shard delta is constant
+    while the active-validator count is (committee counts only change
+    with registry churn), and the epoch index times the current delta
+    modulo the ring gives the same steady rotation.  Deterministic for
+    all nodes evaluating the same state.
+    """
+    cfg = cfg or beacon_config()
+    return (epoch * get_shard_delta(state, epoch, cfg)) % cfg.shard_count
+
+
+def crosslink_committee_index(state, epoch: int, shard: int,
+                              cfg=None) -> int | None:
+    """Position of ``shard`` in the epoch's committee ring, or None if
+    no committee crosslinks this shard this epoch."""
+    cfg = cfg or beacon_config()
+    offset = (shard + cfg.shard_count
+              - get_start_shard(state, epoch, cfg)) % cfg.shard_count
+    if offset >= get_epoch_committee_count(state, epoch, cfg):
+        return None
+    return offset
+
+
+def get_crosslink_committee(state, epoch: int, shard: int,
+                            cfg=None) -> list[int]:
+    """Validators crosslinking ``shard`` at ``epoch`` (v0.8
+    get_crosslink_committee): the beacon committee at the shard's
+    offset in the epoch's (slot, index) committee grid."""
+    cfg = cfg or beacon_config()
+    offset = crosslink_committee_index(state, epoch, shard, cfg)
+    if offset is None:
+        return []
+    per_slot = helpers.get_committee_count_per_slot(state, epoch, cfg)
+    slot = (helpers.compute_start_slot_at_epoch(epoch, cfg)
+            + offset // per_slot)
+    return helpers.get_beacon_committee(state, slot, offset % per_slot,
+                                        cfg)
+
+
+def get_shard_proposer_index(state, epoch: int, shard: int,
+                             cfg=None) -> int | None:
+    """Shard-block proposer: effective-balance-weighted choice from
+    the shard's crosslink committee, seeded per (epoch, shard) under
+    the shard-proposer domain."""
+    cfg = cfg or beacon_config()
+    committee = get_crosslink_committee(state, epoch, shard, cfg)
+    if not committee:
+        return None
+    seed = helpers._sha256(
+        helpers.get_seed(state, epoch, cfg.domain_shard_proposer, cfg)
+        + shard.to_bytes(8, "little"))
+    return helpers.compute_proposer_index(state, committee, seed, cfg)
+
+
+def shard_assignments(state, epoch: int, cfg=None) -> dict[int, int]:
+    """shard -> committee-ring offset for every shard crosslinked this
+    epoch — one pass for duties endpoints."""
+    cfg = cfg or beacon_config()
+    out: dict[int, int] = {}
+    count = min(get_epoch_committee_count(state, epoch, cfg),
+                cfg.shard_count)
+    start = get_start_shard(state, epoch, cfg)
+    for offset in range(count):
+        out[(start + offset) % cfg.shard_count] = offset
+    return out

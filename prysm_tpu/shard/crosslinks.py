@@ -1,0 +1,162 @@
+"""Crosslink processing (Phore "Synapse" analog).
+
+Reference analog: the fork's crosslink epoch processing [U, SURVEY.md
+§2 row 38]; semantics follow the public v0.8.x spec's
+``process_crosslinks`` / ``get_winning_crosslink_and_attesting_indices``.
+
+Phase-0 of this framework (matching the BASELINE symbol era) has no
+crosslink fields in BeaconState, so crosslink records live in a
+sidecar ``CrosslinkStore`` owned by the shard service; with the
+feature off nothing here runs and beacon state roots are untouched.
+
+Winning-crosslink selection is vectorized: per-shard candidate stake
+weights are reduced with numpy over the (candidate, validator) mask
+matrix rather than per-candidate Python set walks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..config import beacon_config
+from ..core import helpers
+from . import committee as shard_committee
+from .types import Crosslink
+
+
+def default_crosslink(shard: int) -> Crosslink:
+    return Crosslink(shard=shard, parent_root=b"\x00" * 32,
+                     start_epoch=0, end_epoch=0, data_root=b"\x00" * 32)
+
+
+@dataclass
+class CrosslinkStore:
+    """Sidecar current/previous crosslink arrays (v0.8 kept these in
+    BeaconState; a sidecar keeps phase-0 roots byte-identical)."""
+
+    shard_count: int
+    current: list[Crosslink] = field(default_factory=list)
+    previous: list[Crosslink] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.current:
+            self.current = [default_crosslink(s)
+                            for s in range(self.shard_count)]
+        if not self.previous:
+            self.previous = [default_crosslink(s)
+                             for s in range(self.shard_count)]
+
+    def hash_tree_root(self) -> bytes:
+        from .. import ssz
+
+        vec = ssz.Vector(Crosslink, self.shard_count)
+        return ssz.hash_tree_root(
+            ssz.Vector(ssz.Bytes32, 2),
+            [vec.hash_tree_root(self.current),
+             vec.hash_tree_root(self.previous)])
+
+
+def get_winning_crosslink_and_attesting_indices(
+        state, store: CrosslinkStore, epoch: int, shard: int,
+        shard_attestations, cfg=None):
+    """(winning_crosslink, attesting_indices) for one shard.
+
+    ``shard_attestations`` is a list of (crosslink, attesting_indices)
+    pairs for this epoch (extracted by the service from the
+    crosslink-attestation sidecar pool).  Candidates must extend the
+    store's current record for the shard — either child (parent_root
+    matches the record's root) or same record re-attested.  The winner
+    has maximal attesting stake; ties break on lexicographically
+    greatest data_root (the deterministic tie-break the spec uses).
+    """
+    cfg = cfg or beacon_config()
+    current_root = Crosslink.hash_tree_root(store.current[shard])
+    candidates: list[tuple[Crosslink, set[int]]] = []
+    for link, indices in shard_attestations:
+        if link.shard != shard:
+            continue
+        if (link.parent_root != current_root
+                and Crosslink.hash_tree_root(link) != current_root):
+            continue
+        for cand, inds in candidates:
+            if Crosslink.hash_tree_root(cand) == \
+                    Crosslink.hash_tree_root(link):
+                inds.update(indices)
+                break
+        else:
+            candidates.append((link, set(indices)))
+    if not candidates:
+        return default_crosslink(shard), set()
+
+    # vectorized stake weighting: (candidates x validators) balance sum
+    all_indices = sorted(set().union(*(inds for _, inds in candidates)))
+    idx_pos = {v: i for i, v in enumerate(all_indices)}
+    balances = np.array(
+        [state.validators[v].effective_balance for v in all_indices],
+        dtype=np.uint64)
+    slashed = np.array(
+        [state.validators[v].slashed for v in all_indices], dtype=bool)
+    mask = np.zeros((len(candidates), len(all_indices)), dtype=bool)
+    for ci, (_, inds) in enumerate(candidates):
+        for v in inds:
+            mask[ci, idx_pos[v]] = True
+    mask &= ~slashed[None, :]
+    stakes = (mask * balances[None, :]).sum(axis=1)
+
+    best = max(
+        range(len(candidates)),
+        key=lambda ci: (int(stakes[ci]),
+                        Crosslink.hash_tree_root(candidates[ci][0])))
+    link, inds = candidates[best]
+    unslashed = {v for v in inds if not state.validators[v].slashed}
+    return link, unslashed
+
+
+def process_crosslinks(state, store: CrosslinkStore,
+                       attestations_for_epoch, cfg=None
+                       ) -> dict[int, Crosslink]:
+    """Epoch-boundary crosslink advance (v0.8 process_crosslinks).
+
+    ``attestations_for_epoch(epoch)`` returns the epoch's
+    (crosslink, attesting_indices) pairs.  For each shard crosslinked
+    in the previous and current epochs, the winning candidate is
+    committed iff its attesting stake reaches 2/3 of the crosslink
+    committee's stake.  Returns {shard: new_crosslink} for the shards
+    that advanced.
+    """
+    cfg = cfg or beacon_config()
+    store.previous = [Crosslink(**{k: getattr(c, k) for k, _ in
+                                   Crosslink.fields})
+                      for c in store.current]
+    committed: dict[int, Crosslink] = {}
+    current_epoch = helpers.get_current_epoch(state)
+    previous_epoch = helpers.get_previous_epoch(state)
+    # spec order matters: previous epoch FIRST, then current — a
+    # current-epoch advance must not orphan previous-epoch candidates
+    # whose parent is the pre-advance record
+    epochs = ([previous_epoch, current_epoch]
+              if previous_epoch != current_epoch else [current_epoch])
+    for epoch in epochs:
+        pairs = attestations_for_epoch(epoch)
+        count = min(shard_committee.get_epoch_committee_count(
+            state, epoch, cfg), cfg.shard_count)
+        start = shard_committee.get_start_shard(state, epoch, cfg)
+        for offset in range(count):
+            shard = (start + offset) % cfg.shard_count
+            cmte = shard_committee.get_crosslink_committee(
+                state, epoch, shard, cfg)
+            if not cmte:
+                continue
+            winner, attesting = \
+                get_winning_crosslink_and_attesting_indices(
+                    state, store, epoch, shard, pairs, cfg)
+            committee_stake = helpers.get_total_balance(state, cmte, cfg)
+            attesting_stake = helpers.get_total_balance(
+                state, attesting, cfg)
+            if attesting_stake * 3 >= committee_stake * 2 \
+                    and winner.end_epoch != 0:
+                store.current[shard] = winner
+                committed[shard] = winner
+    return committed

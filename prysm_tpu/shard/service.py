@@ -1,0 +1,233 @@
+"""Shard-chain service (Phore "Synapse" analog).
+
+Reference analog: the fork's shard-chain service(s) [U, SURVEY.md §2
+row 38].  Maintains one lightweight chain per shard alongside the
+beacon node:
+
+- accepts BLS-signed shard blocks (gossip topic ``shard_block_{n}``),
+  checking the proposer against the shard committee assignment and the
+  signature under the shard-proposer domain;
+- tracks per-shard heads (longest chain, tie-break on block root —
+  crosslink finality, not fork choice weight, is the shard-chain
+  safety argument in this design era);
+- produces the crosslink data root for a shard's epoch span by
+  merkleizing the span's shard-block body roots (routed through the
+  batched device merkleizer for wide spans);
+- collects crosslink attestations and advances the sidecar
+  ``CrosslinkStore`` at epoch boundaries.
+
+Everything is inert unless ``features().shard_chains`` is on.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+from .. import ssz
+from ..config import beacon_config, features
+from ..core import helpers
+from ..crypto.bls import bls
+from . import committee as shard_committee
+from .crosslinks import CrosslinkStore, process_crosslinks
+from .types import Crosslink, build_shard_types, shard_block_header
+
+
+def shard_block_topic(shard: int) -> str:
+    return f"shard_block_{shard}"
+
+
+class ShardServiceError(Exception):
+    pass
+
+
+class ShardService:
+    """Per-shard chains + crosslink sidecar for one node."""
+
+    name = "shard"
+
+    def __init__(self, genesis_root: bytes = b"\x00" * 32, cfg=None):
+        self.cfg = cfg or beacon_config()
+        self.types = build_shard_types(self.cfg)
+        self.store = CrosslinkStore(self.cfg.shard_count)
+        self.genesis_root = genesis_root
+        # shard -> {block_root: SignedShardBlock}
+        self._blocks: dict[int, dict[bytes, object]] = defaultdict(dict)
+        # shard -> {block_root: height}
+        self._height: dict[int, dict[bytes, int]] = defaultdict(dict)
+        self._head: dict[int, bytes] = {}
+        # (epoch, shard) -> list[(Crosslink, attesting_indices)]
+        self._cl_atts: dict[tuple[int, int], list] = defaultdict(list)
+        self._lock = threading.RLock()
+
+    # --- chain maintenance -------------------------------------------------
+
+    def block_root(self, block) -> bytes:
+        return self.types.ShardBlock.hash_tree_root(block)
+
+    def receive_shard_block(self, state, signed) -> bytes:
+        """Validate + insert a signed shard block; returns its root.
+
+        Checks: feature on, shard in range, parent known (or genesis),
+        slot advances the parent, proposer matches the committee
+        assignment, BLS signature valid under the shard-proposer
+        domain.
+        """
+        if not features().shard_chains:
+            raise ShardServiceError("shard chains disabled")
+        cfg = self.cfg
+        block = signed.message
+        shard = block.shard
+        if not (0 <= shard < cfg.shard_count):
+            raise ShardServiceError(f"shard {shard} out of range")
+        with self._lock:
+            root = self.block_root(block)
+            if root in self._blocks[shard]:
+                return root
+            if block.parent_root == self.genesis_root:
+                parent_height = 0
+            else:
+                if block.parent_root not in self._blocks[shard]:
+                    raise ShardServiceError("unknown parent")
+                parent = self._blocks[shard][block.parent_root].message
+                if block.slot <= parent.slot:
+                    raise ShardServiceError("slot does not advance parent")
+                parent_height = self._height[shard][block.parent_root]
+            epoch = helpers.compute_epoch_at_slot(block.slot, cfg)
+            expected = shard_committee.get_shard_proposer_index(
+                state, epoch, shard, cfg)
+            if expected is None or block.proposer_index != expected:
+                raise ShardServiceError(
+                    f"wrong proposer {block.proposer_index}, "
+                    f"want {expected}")
+            domain = helpers.get_domain(
+                state, cfg.domain_shard_proposer, epoch, cfg)
+            root_to_sign = helpers.compute_signing_root(
+                shard_block_header(block, self.types), domain)
+            try:
+                pub = bls.PublicKey.from_bytes(
+                    state.validators[block.proposer_index].pubkey)
+                sig = bls.Signature.from_bytes(signed.signature)
+                ok = sig.verify(pub, root_to_sign)
+            except ValueError as e:
+                raise ShardServiceError(
+                    f"malformed signature/key: {e}") from None
+            if not ok:
+                raise ShardServiceError("bad proposer signature")
+            self._blocks[shard][root] = signed
+            self._height[shard][root] = parent_height + 1
+            head = self._head.get(shard)
+            if (head is None
+                    or self._height[shard][root]
+                    > self._height[shard].get(head, 0)
+                    or (self._height[shard][root]
+                        == self._height[shard].get(head, 0)
+                        and root > head)):
+                self._head[shard] = root
+            return root
+
+    def sign_shard_block(self, state, block, secret_key) -> object:
+        """Produce a SignedShardBlock (validator-client side)."""
+        cfg = self.cfg
+        epoch = helpers.compute_epoch_at_slot(block.slot, cfg)
+        domain = helpers.get_domain(
+            state, cfg.domain_shard_proposer, epoch, cfg)
+        root = helpers.compute_signing_root(
+            shard_block_header(block, self.types), domain)
+        return self.types.SignedShardBlock(
+            message=block, signature=secret_key.sign(root).to_bytes())
+
+    def shard_head(self, shard: int) -> bytes | None:
+        with self._lock:
+            return self._head.get(shard)
+
+    def chain(self, shard: int) -> list:
+        """Head-to-genesis chain of signed blocks, oldest first."""
+        with self._lock:
+            out = []
+            root = self._head.get(shard)
+            while root is not None and root in self._blocks[shard]:
+                signed = self._blocks[shard][root]
+                out.append(signed)
+                root = signed.message.parent_root
+            return list(reversed(out))
+
+    # --- crosslink production ---------------------------------------------
+
+    def crosslink_data_root(self, shard: int, start_epoch: int,
+                            end_epoch: int) -> bytes:
+        """Merkle root of the shard chain's body roots over
+        [start_epoch, end_epoch) — what a crosslink commits to."""
+        cfg = self.cfg
+        body_t = dict(self.types.ShardBlock.fields)["body"]
+        lo = helpers.compute_start_slot_at_epoch(start_epoch, cfg)
+        hi = helpers.compute_start_slot_at_epoch(end_epoch, cfg)
+        roots = [body_t.hash_tree_root(s.message.body)
+                 for s in self.chain(shard)
+                 if lo <= s.message.slot < hi]
+        limit = cfg.max_epochs_per_crosslink * cfg.slots_per_epoch
+        return ssz.List(ssz.Bytes32, limit).hash_tree_root(roots)
+
+    def propose_crosslink(self, state, shard: int) -> Crosslink:
+        """The crosslink an honest attester votes for at the state's
+        current epoch: extends the store's record, spans at most
+        max_epochs_per_crosslink, commits the span's data root."""
+        cfg = self.cfg
+        epoch = helpers.get_current_epoch(state)
+        parent = self.store.current[shard]
+        start = parent.end_epoch
+        end = min(epoch, start + cfg.max_epochs_per_crosslink)
+        if end <= start:
+            end = start + 1
+        return Crosslink(
+            shard=shard,
+            parent_root=Crosslink.hash_tree_root(parent),
+            start_epoch=start,
+            end_epoch=end,
+            data_root=self.crosslink_data_root(shard, start, end),
+        )
+
+    # --- crosslink attestation flow ----------------------------------------
+
+    def on_crosslink_attestation(self, state, link: Crosslink,
+                                 attesting_indices) -> None:
+        """Record a verified crosslink vote (the beacon attestation it
+        rides on is verified by the standard pipeline; the service only
+        needs the crosslink + who attested)."""
+        epoch = helpers.get_current_epoch(state)
+        with self._lock:
+            self._cl_atts[(epoch, link.shard)].append(
+                (link, set(attesting_indices)))
+
+    def attestations_for_epoch(self, epoch: int):
+        with self._lock:
+            out = []
+            for (e, _shard), pairs in self._cl_atts.items():
+                if e == epoch:
+                    out.extend(pairs)
+            return out
+
+    def on_epoch_boundary(self, state) -> dict[int, Crosslink]:
+        """Advance the crosslink store (epoch processing hook, called
+        by the blockchain service on epoch transitions when the
+        feature is on)."""
+        with self._lock:
+            committed = process_crosslinks(
+                state, self.store, self.attestations_for_epoch, self.cfg)
+            cur = helpers.get_current_epoch(state)
+            for key in [k for k in self._cl_atts if k[0] < cur - 1]:
+                del self._cl_atts[key]
+            return committed
+
+    # --- runtime.Service protocol ------------------------------------------
+
+    def start(self) -> None:  # pragma: no cover - registry protocol
+        pass
+
+    def stop(self) -> None:  # pragma: no cover - registry protocol
+        pass
+
+    def status(self) -> str:
+        with self._lock:
+            n = sum(len(b) for b in self._blocks.values())
+            return f"shards={self.cfg.shard_count} blocks={n}"

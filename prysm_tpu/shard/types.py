@@ -1,0 +1,129 @@
+"""Shard-chain containers (Phore "Synapse" analog).
+
+Reference analog: the fork's shard-chain additions [U, SURVEY.md §2
+row 38 "Phore shard additions"].  The reference mount is empty, so no
+file:line citation exists for the fork's own shapes; these containers
+follow the public eth2 phase-0 v0.8.x crosslink-era spec that the
+fork's generation of Prysm derives from (Crosslink, shard blocks,
+per-shard committees), which is the documented ancestry of Synapse's
+sharded design.
+
+The phase-0 beacon containers in ``proto/types.py`` are untouched:
+shard chains are a sidecar subsystem (service + side table), so
+default-chain state roots are byte-identical with the feature off.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from .. import ssz
+from ..config import BeaconChainConfig, beacon_config
+from ..proto.types import AttestationData, MAX_VALIDATORS_PER_COMMITTEE
+
+
+class Crosslink(ssz.Container):
+    """v0.8 Crosslink: commits a span of shard history to the beacon
+    chain.  ``data_root`` is the merkle root of the shard-block body
+    roots over [start_epoch, end_epoch)."""
+    root_memo = True
+    fields = [
+        ("shard", ssz.uint64),
+        ("parent_root", ssz.Bytes32),
+        ("start_epoch", ssz.uint64),
+        ("end_epoch", ssz.uint64),
+        ("data_root", ssz.Bytes32),
+    ]
+
+
+class CrosslinkAttestationData(ssz.Container):
+    """Shard-enabled attestation data: the phase-0 AttestationData plus
+    the crosslink vote (v0.8 kept the crosslink inline; here it wraps,
+    so the base containers stay byte-identical with sharding off)."""
+    fields = [
+        ("data", AttestationData),
+        ("crosslink", Crosslink),
+    ]
+
+
+class CrosslinkAttestation(ssz.Container):
+    fields = [
+        ("aggregation_bits", ssz.Bitlist(MAX_VALIDATORS_PER_COMMITTEE)),
+        ("data", CrosslinkAttestationData),
+        ("signature", ssz.Bytes96),
+    ]
+
+
+_TYPE_CACHE: dict[str, SimpleNamespace] = {}
+
+
+def build_shard_types(cfg: BeaconChainConfig | None = None
+                      ) -> SimpleNamespace:
+    """Config-dependent shard containers (body size limit)."""
+    cfg = cfg or beacon_config()
+    cached = _TYPE_CACHE.get(cfg.preset_name)
+    if cached is not None:
+        return cached
+
+    class ShardBlock(ssz.Container):
+        fields = [
+            ("shard", ssz.uint64),
+            ("slot", ssz.uint64),
+            ("proposer_index", ssz.uint64),
+            ("parent_root", ssz.Bytes32),
+            ("beacon_block_root", ssz.Bytes32),
+            ("state_root", ssz.Bytes32),
+            ("body", ssz.ByteList(cfg.max_shard_block_size)),
+        ]
+
+    class SignedShardBlock(ssz.Container):
+        fields = [
+            ("message", ShardBlock),
+            ("signature", ssz.Bytes96),
+        ]
+
+    class ShardBlockHeader(ssz.Container):
+        fields = [
+            ("shard", ssz.uint64),
+            ("slot", ssz.uint64),
+            ("proposer_index", ssz.uint64),
+            ("parent_root", ssz.Bytes32),
+            ("beacon_block_root", ssz.Bytes32),
+            ("state_root", ssz.Bytes32),
+            ("body_root", ssz.Bytes32),
+        ]
+
+    class ShardState(ssz.Container):
+        """Minimal per-shard state: the chain tip and the running
+        count, merkleized into beacon-side crosslink data roots."""
+        fields = [
+            ("shard", ssz.uint64),
+            ("slot", ssz.uint64),
+            ("latest_block_root", ssz.Bytes32),
+            ("block_count", ssz.uint64),
+        ]
+
+    ns = SimpleNamespace(
+        ShardBlock=ShardBlock,
+        SignedShardBlock=SignedShardBlock,
+        ShardBlockHeader=ShardBlockHeader,
+        ShardState=ShardState,
+        config=cfg,
+    )
+    _TYPE_CACHE[cfg.preset_name] = ns
+    return ns
+
+
+def shard_block_header(block, types=None) -> "ssz.Container":
+    """Header form of a shard block (body replaced by its root)."""
+    types = types or build_shard_types()
+    body_t = dict(types.ShardBlock.fields)["body"]
+    return types.ShardBlockHeader(
+        shard=block.shard,
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        beacon_block_root=block.beacon_block_root,
+        state_root=block.state_root,
+        body_root=body_t.hash_tree_root(block.body),
+    )

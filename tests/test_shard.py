@@ -1,0 +1,276 @@
+"""Shard-chain subsystem tests (SURVEY §2 row 38 Synapse analog)."""
+
+import pytest
+
+from prysm_tpu.config import (
+    beacon_config, set_features, use_minimal_config, use_mainnet_config,
+)
+from prysm_tpu.core import helpers
+from prysm_tpu import shard as shard_mod
+from prysm_tpu.shard import (
+    Crosslink, CrosslinkStore, ShardService, ShardServiceError,
+    build_shard_types, get_crosslink_committee, get_shard_delta,
+    get_shard_proposer_index, get_start_shard, shard_assignments,
+    shard_block_header,
+)
+from prysm_tpu.testing.util import (
+    deterministic_genesis_state, secret_key_for,
+)
+
+
+@pytest.fixture(autouse=True)
+def minimal_with_shards():
+    use_minimal_config()
+    set_features(shard_chains=True, bls_implementation="pure")
+    yield
+    set_features(shard_chains=False)
+    use_mainnet_config()
+
+
+@pytest.fixture(scope="module")
+def state():
+    use_minimal_config()
+    try:
+        yield deterministic_genesis_state(64)
+    finally:
+        use_mainnet_config()
+
+
+class TestShardCommittees:
+    def test_assignments_cover_distinct_shards(self, state):
+        cfg = beacon_config()
+        asg = shard_assignments(state, 0)
+        assert len(asg) >= 1
+        assert all(0 <= s < cfg.shard_count for s in asg)
+        # offsets are distinct per shard
+        assert len(set(asg.values())) == len(asg)
+
+    def test_committee_nonempty_and_subset_of_validators(self, state):
+        asg = shard_assignments(state, 0)
+        for s in asg:
+            cmte = get_crosslink_committee(state, 0, s)
+            assert cmte, f"shard {s} committee empty"
+            assert all(0 <= v < len(state.validators) for v in cmte)
+
+    def test_unassigned_shard_has_no_committee(self, state):
+        cfg = beacon_config()
+        asg = shard_assignments(state, 0)
+        if len(asg) < cfg.shard_count:
+            missing = next(s for s in range(cfg.shard_count)
+                           if s not in asg)
+            assert get_crosslink_committee(state, 0, missing) == []
+
+    def test_start_shard_rotates(self, state):
+        cfg = beacon_config()
+        delta = get_shard_delta(state, 0)
+        assert 0 < delta <= cfg.shard_count
+        s0 = get_start_shard(state, 0)
+        s1 = get_start_shard(state, 1)
+        assert s1 == (s0 + delta) % cfg.shard_count or delta == \
+            get_shard_delta(state, 1)
+
+    def test_deterministic(self, state):
+        for s in shard_assignments(state, 0):
+            assert get_crosslink_committee(state, 0, s) == \
+                get_crosslink_committee(state, 0, s)
+
+    def test_proposer_member_of_committee(self, state):
+        for s in shard_assignments(state, 0):
+            p = get_shard_proposer_index(state, 0, s)
+            assert p in get_crosslink_committee(state, 0, s)
+
+
+def _make_block(svc, state, sh, slot, parent_root, body=b"data"):
+    t = svc.types
+    proposer = get_shard_proposer_index(
+        state, helpers.compute_epoch_at_slot(slot), sh)
+    return t.ShardBlock(
+        shard=sh, slot=slot, proposer_index=proposer,
+        parent_root=parent_root, beacon_block_root=b"\x11" * 32,
+        state_root=b"\x00" * 32, body=body)
+
+
+class TestShardBlocks:
+    def test_receive_valid_block(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        blk = _make_block(svc, state, sh, 1, svc.genesis_root)
+        signed = svc.sign_shard_block(
+            state, blk, secret_key_for(blk.proposer_index))
+        root = svc.receive_shard_block(state, signed)
+        assert svc.shard_head(sh) == root
+        assert len(svc.chain(sh)) == 1
+
+    def test_reject_wrong_proposer(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        blk = _make_block(svc, state, sh, 1, svc.genesis_root)
+        wrong = (blk.proposer_index + 1) % len(state.validators)
+        blk.proposer_index = wrong
+        signed = svc.sign_shard_block(state, blk, secret_key_for(wrong))
+        with pytest.raises(ShardServiceError, match="proposer"):
+            svc.receive_shard_block(state, signed)
+
+    def test_reject_bad_signature(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        blk = _make_block(svc, state, sh, 1, svc.genesis_root)
+        # signed by someone other than the proposer
+        signed = svc.sign_shard_block(
+            state, blk,
+            secret_key_for((blk.proposer_index + 1)
+                           % len(state.validators)))
+        with pytest.raises(ShardServiceError, match="signature"):
+            svc.receive_shard_block(state, signed)
+
+    def test_reject_malformed_signature_bytes(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        blk = _make_block(svc, state, sh, 1, svc.genesis_root)
+        signed = svc.sign_shard_block(
+            state, blk, secret_key_for(blk.proposer_index))
+        signed.signature = bytes(96)  # non-canonical, not a G2 point
+        with pytest.raises(ShardServiceError, match="malformed"):
+            svc.receive_shard_block(state, signed)
+
+    def test_reject_unknown_parent(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        blk = _make_block(svc, state, sh, 2, b"\xaa" * 32)
+        signed = svc.sign_shard_block(
+            state, blk, secret_key_for(blk.proposer_index))
+        with pytest.raises(ShardServiceError, match="parent"):
+            svc.receive_shard_block(state, signed)
+
+    def test_reject_feature_off(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        blk = _make_block(svc, state, sh, 1, svc.genesis_root)
+        signed = svc.sign_shard_block(
+            state, blk, secret_key_for(blk.proposer_index))
+        set_features(shard_chains=False)
+        with pytest.raises(ShardServiceError, match="disabled"):
+            svc.receive_shard_block(state, signed)
+
+    def test_chain_extension_and_head(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        parent = svc.genesis_root
+        roots = []
+        for slot in (1, 2, 3):
+            blk = _make_block(svc, state, sh, slot, parent,
+                              body=bytes([slot]) * 8)
+            signed = svc.sign_shard_block(
+                state, blk, secret_key_for(blk.proposer_index))
+            parent = svc.receive_shard_block(state, signed)
+            roots.append(parent)
+        assert svc.shard_head(sh) == roots[-1]
+        chain = svc.chain(sh)
+        assert [svc.block_root(s.message) for s in chain] == roots
+
+    def test_header_roundtrip(self, state):
+        svc = ShardService()
+        blk = _make_block(svc, state, 0, 1, svc.genesis_root)
+        hdr = shard_block_header(blk, svc.types)
+        assert hdr.slot == blk.slot
+        t = svc.types
+        body_t = dict(t.ShardBlock.fields)["body"]
+        assert hdr.body_root == body_t.hash_tree_root(blk.body)
+
+
+class TestCrosslinks:
+    def _vote(self, svc, state, sh):
+        link = svc.propose_crosslink(state, sh)
+        cmte = get_crosslink_committee(
+            state, helpers.get_current_epoch(state), sh)
+        return link, cmte
+
+    def test_propose_extends_store(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        link = svc.propose_crosslink(state, sh)
+        assert link.parent_root == Crosslink.hash_tree_root(
+            svc.store.current[sh])
+        assert link.end_epoch > link.start_epoch
+
+    def test_supermajority_commits(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        link, cmte = self._vote(svc, state, sh)
+        svc.on_crosslink_attestation(state, link, cmte)  # 100% votes
+        committed = svc.on_epoch_boundary(state)
+        assert committed.get(sh) is not None
+        assert Crosslink.hash_tree_root(svc.store.current[sh]) == \
+            Crosslink.hash_tree_root(link)
+
+    def test_minority_does_not_commit(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        link, cmte = self._vote(svc, state, sh)
+        third = cmte[:max(1, len(cmte) // 3)]
+        if len(third) * 3 >= len(cmte) * 2:
+            pytest.skip("committee too small to form a minority")
+        svc.on_crosslink_attestation(state, link, third)
+        committed = svc.on_epoch_boundary(state)
+        assert sh not in committed
+
+    def test_winner_by_stake_tiebreak_by_root(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        base, cmte = self._vote(svc, state, sh)
+        a = Crosslink(shard=sh, parent_root=base.parent_root,
+                      start_epoch=base.start_epoch,
+                      end_epoch=base.end_epoch, data_root=b"\xaa" * 32)
+        b = Crosslink(shard=sh, parent_root=base.parent_root,
+                      start_epoch=base.start_epoch,
+                      end_epoch=base.end_epoch, data_root=b"\xbb" * 32)
+        from prysm_tpu.shard import (
+            get_winning_crosslink_and_attesting_indices as winning,
+        )
+        # equal stake -> lexicographically greater HTR wins
+        half = len(cmte) // 2
+        pairs = [(a, set(cmte[:half])), (b, set(cmte[half:2 * half]))]
+        w, inds = winning(state, svc.store, 0, sh, pairs)
+        want = max((a, b), key=Crosslink.hash_tree_root)
+        assert Crosslink.hash_tree_root(w) == \
+            Crosslink.hash_tree_root(want)
+        # more stake beats root order
+        pairs = [(a, set(cmte)), (b, set(cmte[:half]))]
+        w, inds = winning(state, svc.store, 0, sh, pairs)
+        assert Crosslink.hash_tree_root(w) == Crosslink.hash_tree_root(a)
+        assert inds == set(cmte)
+
+    def test_non_extending_candidate_ignored(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        stray = Crosslink(shard=sh, parent_root=b"\x77" * 32,
+                          start_epoch=0, end_epoch=1,
+                          data_root=b"\xcc" * 32)
+        cmte = get_crosslink_committee(state, 0, sh)
+        from prysm_tpu.shard import (
+            get_winning_crosslink_and_attesting_indices as winning,
+        )
+        w, inds = winning(state, svc.store, 0, sh,
+                          [(stray, set(cmte))])
+        assert inds == set()
+
+    def test_data_root_commits_chain_segment(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        empty = svc.crosslink_data_root(sh, 0, 1)
+        blk = _make_block(svc, state, sh, 1, svc.genesis_root,
+                          body=b"payload")
+        signed = svc.sign_shard_block(
+            state, blk, secret_key_for(blk.proposer_index))
+        svc.receive_shard_block(state, signed)
+        filled = svc.crosslink_data_root(sh, 0, 1)
+        assert filled != empty
+
+    def test_store_root_changes_on_commit(self, state):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state, 0)))
+        before = svc.store.hash_tree_root()
+        link, cmte = self._vote(svc, state, sh)
+        svc.on_crosslink_attestation(state, link, cmte)
+        svc.on_epoch_boundary(state)
+        assert svc.store.hash_tree_root() != before
