@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow warm-cache dryrun bench native
+.PHONY: test test-slow warm-cache dryrun bench native proto
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -30,6 +30,11 @@ dryrun:
 
 bench:
 	$(PY) bench.py
+
+# Regenerate the protobuf module from the v1alpha1 service schema.
+proto:
+	protoc --python_out=prysm_tpu/proto --proto_path=prysm_tpu/proto \
+		prysm_tpu/proto/v1alpha1.proto
 
 native:
 	$(MAKE) -C native

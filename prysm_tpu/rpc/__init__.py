@@ -6,5 +6,9 @@ service + Eth Beacon REST gateway) [U, SURVEY.md §2 "RPC"].
 
 from .api import ValidatorAPI, APIError
 from .http_server import BeaconHTTPServer
+from .grpc_server import (
+    RpcError, ValidatorRpcClient, ValidatorRpcServer,
+)
 
-__all__ = ["ValidatorAPI", "APIError", "BeaconHTTPServer"]
+__all__ = ["ValidatorAPI", "APIError", "BeaconHTTPServer",
+           "RpcError", "ValidatorRpcClient", "ValidatorRpcServer"]

@@ -51,6 +51,13 @@ class ValidatorAPI:
         chain = self.node.chain
         cfg = beacon_config()
         start = compute_start_slot_at_epoch(epoch)
+        # bound the advance: duties are served for at most one epoch
+        # past the head (honest clients ask for current/next epoch);
+        # an arbitrary epoch would burn unbounded epoch processing
+        horizon = chain.head_slot() + 2 * cfg.slots_per_epoch
+        if start > horizon:
+            raise APIError(
+                f"epoch {epoch} beyond the duty horizon")
         # anchor at the chain's block at/before the epoch start so the
         # per-slot proposer walk below never needs to rewind (proposer
         # seeds depend on the exact slot)
@@ -114,6 +121,14 @@ class ValidatorAPI:
         if slot <= chain.head_slot():
             raise APIError(f"slot {slot} not after head "
                            f"{chain.head_slot()}")
+        # a proposal slot far past the head would advance the state
+        # arbitrarily many slots (DoS via epoch processing); honest
+        # proposals are within one epoch of the head
+        horizon = (chain.head_slot()
+                   + 2 * beacon_config().slots_per_epoch)
+        if slot > horizon:
+            raise APIError(
+                f"slot {slot} beyond the proposal horizon {horizon}")
         pre = chain.stategen.state_by_root(chain.head_root)
         work = pre.copy()
         process_slots(work, slot, types)
@@ -213,6 +228,11 @@ class ValidatorAPI:
         chain = self.node.chain
         state = chain.head_state
         if state.slot < slot:
+            horizon = (chain.head_slot()
+                       + 2 * beacon_config().slots_per_epoch)
+            if slot > horizon:
+                raise APIError(
+                    f"slot {slot} beyond the attestation horizon")
             state = state.copy()
             process_slots(state, slot, self.node.types)
         epoch = compute_epoch_at_slot(slot)

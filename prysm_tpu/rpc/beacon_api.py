@@ -270,7 +270,7 @@ class BeaconAPI:
         start = compute_start_slot_at_epoch(epoch)
         horizon = (self.node.chain.head_slot()
                    + 2 * beacon_config().slots_per_epoch)
-        if start > horizon:
+        if epoch < 0 or start > horizon:
             raise APIError(
                 f"epoch {epoch} beyond the serveable horizon")
         if st.slot < start:

@@ -115,12 +115,12 @@ def get_winning_crosslink_and_attesting_indices(
 
 
 def process_crosslinks(state, store: CrosslinkStore,
-                       attestations_for_epoch, cfg=None
+                       attestations_for, cfg=None
                        ) -> dict[int, Crosslink]:
     """Epoch-boundary crosslink advance (v0.8 process_crosslinks).
 
-    ``attestations_for_epoch(epoch)`` returns the epoch's
-    (crosslink, attesting_indices) pairs.  For each shard crosslinked
+    ``attestations_for(epoch, shard)`` returns that pair's
+    (crosslink, attesting_indices) list.  For each shard crosslinked
     in the previous and current epochs, the winning candidate is
     committed iff its attesting stake reaches 2/3 of the crosslink
     committee's stake.  Returns {shard: new_crosslink} for the shards
@@ -139,7 +139,6 @@ def process_crosslinks(state, store: CrosslinkStore,
     epochs = ([previous_epoch, current_epoch]
               if previous_epoch != current_epoch else [current_epoch])
     for epoch in epochs:
-        pairs = attestations_for_epoch(epoch)
         count = min(shard_committee.get_epoch_committee_count(
             state, epoch, cfg), cfg.shard_count)
         start = shard_committee.get_start_shard(state, epoch, cfg)
@@ -151,7 +150,8 @@ def process_crosslinks(state, store: CrosslinkStore,
                 continue
             winner, attesting = \
                 get_winning_crosslink_and_attesting_indices(
-                    state, store, epoch, shard, pairs, cfg)
+                    state, store, epoch, shard,
+                    attestations_for(epoch, shard), cfg)
             committee_stake = helpers.get_total_balance(state, cmte, cfg)
             attesting_stake = helpers.get_total_balance(
                 state, attesting, cfg)

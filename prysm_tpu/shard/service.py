@@ -168,17 +168,22 @@ class ShardService:
         limit = cfg.max_epochs_per_crosslink * cfg.slots_per_epoch
         return ssz.List(ssz.Bytes32, limit).hash_tree_root(roots)
 
-    def propose_crosslink(self, state, shard: int) -> Crosslink:
+    def propose_crosslink(self, state, shard: int) -> Crosslink | None:
         """The crosslink an honest attester votes for at the state's
-        current epoch: extends the store's record, spans at most
-        max_epochs_per_crosslink, commits the span's data root."""
+        current epoch, or None when nothing stable exists to commit.
+
+        The span covers only COMPLETED epochs ([start, current)): an
+        in-progress epoch's shard chain is still growing, so including
+        it would make the data_root a moving target within the epoch —
+        committee members voting at different instants would split the
+        2/3 stake across differing roots and stall the shard."""
         cfg = self.cfg
         epoch = helpers.get_current_epoch(state)
         parent = self.store.current[shard]
         start = parent.end_epoch
         end = min(epoch, start + cfg.max_epochs_per_crosslink)
         if end <= start:
-            end = start + 1
+            return None
         return Crosslink(
             shard=shard,
             parent_root=Crosslink.hash_tree_root(parent),
@@ -199,13 +204,12 @@ class ShardService:
             self._cl_atts[(epoch, link.shard)].append(
                 (link, set(attesting_indices)))
 
-    def attestations_for_epoch(self, epoch: int):
+    def attestations_for(self, epoch: int, shard: int):
+        """(crosslink, indices) pairs for one (epoch, shard) — the
+        pool is already keyed that way, so this is a dict lookup, not
+        a scan."""
         with self._lock:
-            out = []
-            for (e, _shard), pairs in self._cl_atts.items():
-                if e == epoch:
-                    out.extend(pairs)
-            return out
+            return list(self._cl_atts.get((epoch, shard), ()))
 
     def on_epoch_boundary(self, state) -> dict[int, Crosslink]:
         """Advance the crosslink store (epoch processing hook, called
@@ -213,7 +217,7 @@ class ShardService:
         feature is on)."""
         with self._lock:
             committed = process_crosslinks(
-                state, self.store, self.attestations_for_epoch, self.cfg)
+                state, self.store, self.attestations_for, self.cfg)
             cur = helpers.get_current_epoch(state)
             for key in [k for k in self._cl_atts if k[0] < cur - 1]:
                 del self._cl_atts[key]

@@ -1,0 +1,194 @@
+"""gRPC-semantics RPC tests: protobuf v1alpha1 service over TCP.
+
+A live node serves ``ValidatorRpcServer``; a ``ValidatorRpcClient``
+stub (mirroring ValidatorAPI's signatures) drives duties, block
+production, and the attestation flow across a real socket."""
+
+import socket
+import struct
+
+import pytest
+
+from prysm_tpu.config import use_mainnet_config, use_minimal_config
+from prysm_tpu.p2p import GossipBus
+from prysm_tpu.proto import build_types
+from prysm_tpu.rpc import (
+    RpcError, ValidatorAPI, ValidatorRpcClient, ValidatorRpcServer,
+)
+from prysm_tpu.rpc.grpc_server import (
+    INVALID_ARGUMENT, NOT_FOUND, SERVICE, _recv_frame, _send_frame,
+)
+from prysm_tpu.testing import util as testutil
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_config():
+    use_minimal_config()
+    yield
+    use_mainnet_config()
+
+
+@pytest.fixture(scope="module")
+def types():
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    return build_types(MINIMAL_CONFIG)
+
+
+@pytest.fixture()
+def rig(types):
+    from prysm_tpu.node import BeaconNode
+
+    genesis = testutil.deterministic_genesis_state(16, types)
+    bus = GossipBus()
+    node = BeaconNode(bus, "rpc-node", genesis, types=types)
+    server = ValidatorRpcServer(ValidatorAPI(node))
+    server.start()
+    client = ValidatorRpcClient(server.host, server.port, types=types)
+    yield node, server, client
+    client.close()
+    server.stop()
+    node.stop()
+
+
+class TestRpcSurface:
+    def test_health(self, rig):
+        node, _server, client = rig
+        h = client.node_health()
+        assert h["head_slot"] == 0
+        assert h["head_root"] == node.chain.head_root.hex()
+
+    def test_duties_roundtrip(self, rig):
+        node, _server, client = rig
+        from prysm_tpu.validator import KeyManager
+
+        km = KeyManager.deterministic(16)
+        duties = client.get_duties(0, km.pubkeys())
+        attesters = {d.validator_index for d in duties
+                     if d.attester_slot >= 0}
+        assert attesters == set(range(16))
+        # matches the in-process API exactly
+        direct = ValidatorAPI(node).get_duties(0, km.pubkeys())
+        by_vi = {d.validator_index: d for d in direct}
+        for d in duties:
+            want = by_vi[d.validator_index]
+            assert d.committee == want.committee
+            assert d.attester_slot == want.attester_slot
+            assert d.proposer_slots == want.proposer_slots
+
+    def test_domain_data(self, rig):
+        node, _server, client = rig
+        from prysm_tpu.config import beacon_config
+        from prysm_tpu.core.helpers import get_domain
+
+        cfg = beacon_config()
+        dom = client.domain_data(0, cfg.domain_randao)
+        assert dom == get_domain(node.chain.head_state,
+                                 cfg.domain_randao, 0)
+
+    def test_block_proposal_over_rpc(self, rig, types):
+        node, _server, client = rig
+        from prysm_tpu.validator import KeyManager
+
+        km = KeyManager.deterministic(16)
+        duties = client.get_duties(0, km.pubkeys())
+        duty = next(d for d in duties if 1 in d.proposer_slots)
+        from prysm_tpu.config import beacon_config
+        from prysm_tpu.core.helpers import compute_signing_root
+        from prysm_tpu.core.transition import _Uint64Box
+
+        cfg = beacon_config()
+        # every signing domain fetched over the socket too
+        randao_domain = client.domain_data(0, cfg.domain_randao)
+        reveal = km.sign(duty.pubkey,
+                         compute_signing_root(_Uint64Box(0),
+                                              randao_domain))
+        block = client.get_block_proposal(1, reveal.to_bytes())
+        assert block.slot == 1
+        # sign + propose over the socket
+        proposer_domain = client.domain_data(
+            0, cfg.domain_beacon_proposer)
+        root = compute_signing_root(block, proposer_domain)
+        signed = types.SignedBeaconBlock(
+            message=block, signature=km.sign(duty.pubkey,
+                                             root).to_bytes())
+        block_root = client.submit_block(signed)
+        assert node.head_slot() == 1
+        assert node.chain.head_root == block_root
+
+    def test_attestation_flow_over_rpc(self, rig):
+        node, _server, client = rig
+        data = client.get_attestation_data(0, 0)
+        assert data.slot == 0
+        from prysm_tpu.core.helpers import get_beacon_committee
+        from prysm_tpu.proto import Attestation
+
+        committee = get_beacon_committee(node.chain.head_state, 0, 0)
+        bits = [False] * len(committee)
+        bits[0] = True
+        sig = testutil.sign_attestation_for_committee(
+            node.chain.head_state, data, [committee[0]])
+        att = Attestation(aggregation_bits=bits, data=data,
+                          signature=sig)
+        client.submit_attestation(att)
+        assert node.att_pool.unaggregated_count() == 1
+        agg = client.get_aggregate_attestation(0, 0)
+        assert agg is not None
+        assert agg.data.slot == 0
+
+    def test_error_maps_to_status(self, rig):
+        _node, _server, client = rig
+        with pytest.raises(RpcError) as ei:
+            client.get_block_proposal(10**9, b"\x00" * 96)
+        assert ei.value.code == INVALID_ARGUMENT
+
+    def test_bad_domain_type_rejected(self, rig):
+        _node, _server, client = rig
+        with pytest.raises(RpcError) as ei:
+            client.domain_data(0, b"\x00" * 7)
+        assert ei.value.code == INVALID_ARGUMENT
+
+
+class TestWireProtocol:
+    def _raw_call(self, server, method: str, payload: bytes = b""):
+        sock = socket.create_connection((server.host, server.port),
+                                        timeout=5)
+        try:
+            body = (struct.pack("<H", len(method)) + method.encode()
+                    + payload)
+            _send_frame(sock, body)
+            resp = _recv_frame(sock)
+            return resp[0], resp[1:]
+        finally:
+            sock.close()
+
+    def test_unknown_method_not_found(self, rig):
+        _node, server, _client = rig
+        status, _ = self._raw_call(server, SERVICE + "NoSuchMethod")
+        assert status == NOT_FOUND
+
+    def test_unknown_service_not_found(self, rig):
+        _node, server, _client = rig
+        status, _ = self._raw_call(server, "/other.Service/Method")
+        assert status == NOT_FOUND
+
+    def test_garbage_payload_is_invalid_not_crash(self, rig):
+        _node, server, client = rig
+        status, _ = self._raw_call(server, SERVICE + "GetDuties",
+                                   b"\xff\xff\xff\xff\xff")
+        assert status != 0
+        # server still serves afterwards
+        assert client.node_health()["head_slot"] >= 0
+
+    def test_oversized_frame_closes_connection(self, rig):
+        _node, server, _client = rig
+        sock = socket.create_connection((server.host, server.port),
+                                        timeout=5)
+        try:
+            sock.sendall(struct.pack("<I", 1 << 30))
+            sock.sendall(b"\x00" * 64)
+            # server must drop us, not allocate 1 GiB
+            sock.settimeout(5)
+            assert sock.recv(4) == b""
+        finally:
+            sock.close()

@@ -36,6 +36,21 @@ def state():
         use_mainnet_config()
 
 
+@pytest.fixture(scope="module")
+def state1(state):
+    """State advanced to epoch 1 — crosslink votes span only
+    completed epochs, so epoch 0 must have elapsed."""
+    from prysm_tpu.config import MINIMAL_CONFIG
+    from prysm_tpu.core.transition import process_slots
+    from prysm_tpu.proto import build_types
+
+    use_minimal_config()
+    st = state.copy()
+    process_slots(st, beacon_config().slots_per_epoch,
+                  build_types(MINIMAL_CONFIG))
+    return st
+
+
 class TestShardCommittees:
     def test_assignments_cover_distinct_shards(self, state):
         cfg = beacon_config()
@@ -179,45 +194,56 @@ class TestShardBlocks:
 
 
 class TestCrosslinks:
-    def _vote(self, svc, state, sh):
-        link = svc.propose_crosslink(state, sh)
+    def _vote(self, svc, state1, sh):
+        link = svc.propose_crosslink(state1, sh)
+        assert link is not None
         cmte = get_crosslink_committee(
-            state, helpers.get_current_epoch(state), sh)
+            state1, helpers.get_current_epoch(state1), sh)
         return link, cmte
 
-    def test_propose_extends_store(self, state):
+    def test_no_vote_at_genesis(self, state):
+        """Nothing is stable to commit before an epoch has elapsed:
+        an in-progress epoch's data root would be a moving target."""
         svc = ShardService()
         sh = next(iter(shard_assignments(state, 0)))
-        link = svc.propose_crosslink(state, sh)
+        assert svc.propose_crosslink(state, sh) is None
+
+    def test_propose_extends_store(self, state1):
+        svc = ShardService()
+        sh = next(iter(shard_assignments(state1, 1)))
+        link = svc.propose_crosslink(state1, sh)
+        assert link is not None
         assert link.parent_root == Crosslink.hash_tree_root(
             svc.store.current[sh])
         assert link.end_epoch > link.start_epoch
+        # spans only COMPLETED epochs
+        assert link.end_epoch <= helpers.get_current_epoch(state1)
 
-    def test_supermajority_commits(self, state):
+    def test_supermajority_commits(self, state1):
         svc = ShardService()
-        sh = next(iter(shard_assignments(state, 0)))
-        link, cmte = self._vote(svc, state, sh)
-        svc.on_crosslink_attestation(state, link, cmte)  # 100% votes
-        committed = svc.on_epoch_boundary(state)
+        sh = next(iter(shard_assignments(state1, 1)))
+        link, cmte = self._vote(svc, state1, sh)
+        svc.on_crosslink_attestation(state1, link, cmte)  # 100% votes
+        committed = svc.on_epoch_boundary(state1)
         assert committed.get(sh) is not None
         assert Crosslink.hash_tree_root(svc.store.current[sh]) == \
             Crosslink.hash_tree_root(link)
 
-    def test_minority_does_not_commit(self, state):
+    def test_minority_does_not_commit(self, state1):
         svc = ShardService()
-        sh = next(iter(shard_assignments(state, 0)))
-        link, cmte = self._vote(svc, state, sh)
+        sh = next(iter(shard_assignments(state1, 1)))
+        link, cmte = self._vote(svc, state1, sh)
         third = cmte[:max(1, len(cmte) // 3)]
         if len(third) * 3 >= len(cmte) * 2:
             pytest.skip("committee too small to form a minority")
-        svc.on_crosslink_attestation(state, link, third)
-        committed = svc.on_epoch_boundary(state)
+        svc.on_crosslink_attestation(state1, link, third)
+        committed = svc.on_epoch_boundary(state1)
         assert sh not in committed
 
-    def test_winner_by_stake_tiebreak_by_root(self, state):
+    def test_winner_by_stake_tiebreak_by_root(self, state1):
         svc = ShardService()
-        sh = next(iter(shard_assignments(state, 0)))
-        base, cmte = self._vote(svc, state, sh)
+        sh = next(iter(shard_assignments(state1, 1)))
+        base, cmte = self._vote(svc, state1, sh)
         a = Crosslink(shard=sh, parent_root=base.parent_root,
                       start_epoch=base.start_epoch,
                       end_epoch=base.end_epoch, data_root=b"\xaa" * 32)
@@ -230,13 +256,13 @@ class TestCrosslinks:
         # equal stake -> lexicographically greater HTR wins
         half = len(cmte) // 2
         pairs = [(a, set(cmte[:half])), (b, set(cmte[half:2 * half]))]
-        w, inds = winning(state, svc.store, 0, sh, pairs)
+        w, inds = winning(state1, svc.store, 1, sh, pairs)
         want = max((a, b), key=Crosslink.hash_tree_root)
         assert Crosslink.hash_tree_root(w) == \
             Crosslink.hash_tree_root(want)
         # more stake beats root order
         pairs = [(a, set(cmte)), (b, set(cmte[:half]))]
-        w, inds = winning(state, svc.store, 0, sh, pairs)
+        w, inds = winning(state1, svc.store, 1, sh, pairs)
         assert Crosslink.hash_tree_root(w) == Crosslink.hash_tree_root(a)
         assert inds == set(cmte)
 
@@ -266,11 +292,11 @@ class TestCrosslinks:
         filled = svc.crosslink_data_root(sh, 0, 1)
         assert filled != empty
 
-    def test_store_root_changes_on_commit(self, state):
+    def test_store_root_changes_on_commit(self, state1):
         svc = ShardService()
-        sh = next(iter(shard_assignments(state, 0)))
+        sh = next(iter(shard_assignments(state1, 1)))
         before = svc.store.hash_tree_root()
-        link, cmte = self._vote(svc, state, sh)
-        svc.on_crosslink_attestation(state, link, cmte)
-        svc.on_epoch_boundary(state)
+        link, cmte = self._vote(svc, state1, sh)
+        svc.on_crosslink_attestation(state1, link, cmte)
+        svc.on_epoch_boundary(state1)
         assert svc.store.hash_tree_root() != before
