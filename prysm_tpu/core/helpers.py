@@ -362,6 +362,19 @@ def compute_signing_root(obj, domain: bytes) -> bytes:
     return SigningData(object_root=obj.root(), domain=domain).root()
 
 
+def is_aggregator_for_committee(committee_len: int,
+                                slot_signature: bytes,
+                                cfg=None) -> bool:
+    """is_aggregator given the committee size directly — the form a
+    remote validator client uses (its duty already carries the
+    committee, so no state access is needed)."""
+    cfg = cfg or beacon_config()
+    modulo = max(1, committee_len
+                 // cfg.target_aggregators_per_committee)
+    return int.from_bytes(_sha256(slot_signature)[0:8],
+                          "little") % modulo == 0
+
+
 def is_aggregator(state, slot: int, index: int,
                   slot_signature: bytes, cfg=None) -> bool:
     """Spec is_aggregator: the selection proof hashes into a
@@ -369,10 +382,8 @@ def is_aggregator(state, slot: int, index: int,
     aggregator duty [U, SURVEY.md §3.4])."""
     cfg = cfg or beacon_config()
     committee = get_beacon_committee(state, slot, index, cfg)
-    modulo = max(1, len(committee)
-                 // cfg.target_aggregators_per_committee)
-    return int.from_bytes(_sha256(slot_signature)[0:8],
-                          "little") % modulo == 0
+    return is_aggregator_for_committee(len(committee), slot_signature,
+                                       cfg)
 
 
 def latest_header_root(state) -> bytes:

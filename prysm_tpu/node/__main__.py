@@ -38,6 +38,14 @@ def main(argv=None) -> int:
     p.add_argument("--enable-tracing", action="store_true")
     p.add_argument("--metrics", action="store_true",
                    help="print the /metrics exposition at the end")
+    p.add_argument("--rpc-port", type=int, default=None,
+                   help="serve the v1alpha1 validator RPC (framed "
+                        "protobuf over TCP) for node 0 on this port")
+    p.add_argument("--serve", action="store_true",
+                   help="wall-clock mode: no scripted proposals; an "
+                        "external validator client (python -m "
+                        "prysm_tpu.validator) drives duties over "
+                        "--rpc-port for --slots slots")
     args = p.parse_args(argv)
 
     from ..config import (
@@ -77,17 +85,41 @@ def main(argv=None) -> int:
     print(f"started {args.nodes} nodes, {args.validators} validators, "
           f"bls={args.bls_implementation}")
 
-    st = genesis.copy()
-    proposer_node = nodes[0]
-    for slot in range(1, args.slots + 1):
-        blk = generate_full_block(st, slot=slot)
-        state_transition(st, blk, types, verify_signatures=False)
-        proposer_node.chain.receive_block(blk)
-        proposer_node.peer.broadcast(
-            TOPIC_BLOCK, types.SignedBeaconBlock.serialize(blk))
-        heads = {n.node_id: n.head_slot() for n in nodes}
-        print(f"slot {slot}: heads={heads}")
+    rpc_server = None
+    if args.rpc_port is not None:
+        from ..rpc import ValidatorAPI, ValidatorRpcServer
 
+        rpc_server = ValidatorRpcServer(ValidatorAPI(nodes[0]),
+                                        port=args.rpc_port)
+        rpc_server.start()
+        print(f"validator RPC on {rpc_server.host}:{rpc_server.port}",
+              flush=True)
+
+    if args.serve:
+        # wall-clock mode: duties arrive over RPC from an external
+        # validator process (the reference's two-binary deployment)
+        from ..config import beacon_config
+
+        spslot = beacon_config().seconds_per_slot
+        deadline = genesis.genesis_time + (args.slots + 1) * spslot
+        while time.time() < deadline:
+            time.sleep(0.25)
+        heads = {n.node_id: n.head_slot() for n in nodes}
+        print(f"serve window over: heads={heads}")
+    else:
+        st = genesis.copy()
+        proposer_node = nodes[0]
+        for slot in range(1, args.slots + 1):
+            blk = generate_full_block(st, slot=slot)
+            state_transition(st, blk, types, verify_signatures=False)
+            proposer_node.chain.receive_block(blk)
+            proposer_node.peer.broadcast(
+                TOPIC_BLOCK, types.SignedBeaconBlock.serialize(blk))
+            heads = {n.node_id: n.head_slot() for n in nodes}
+            print(f"slot {slot}: heads={heads}")
+
+    if rpc_server is not None:
+        rpc_server.stop()
     roots = {n.head_root() for n in nodes}
     ok = len(roots) == 1
     print("consensus:", "OK" if ok else f"SPLIT ({len(roots)} heads)")

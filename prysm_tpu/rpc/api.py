@@ -295,6 +295,18 @@ class ValidatorAPI:
         self.node.peer.broadcast(
             TOPIC_AGGREGATE, SignedAggregateAndProof.serialize(signed))
 
+    def domain_data(self, epoch: int, domain_type: bytes) -> bytes:
+        """DomainData analog: the signing domain for (epoch, type)
+        from the head state's fork — lets a validator client sign
+        without any state access (the gRPC stub serves the same
+        method remotely)."""
+        from ..core.helpers import get_domain
+
+        if len(domain_type) != 4:
+            raise APIError("domain_type must be 4 bytes")
+        return get_domain(self.node.chain.head_state, domain_type,
+                          epoch)
+
     # --- node status -------------------------------------------------------
 
     def node_health(self) -> dict:
@@ -302,6 +314,7 @@ class ValidatorAPI:
         return {
             "head_slot": chain.head_slot(),
             "head_root": chain.head_root.hex(),
+            "genesis_time": chain.head_state.genesis_time,
             "justified_epoch": chain.justified_checkpoint.epoch,
             "finalized_epoch": chain.finalized_checkpoint.epoch,
             "peers": len(self.node.peer.peers()),

@@ -229,7 +229,8 @@ class ValidatorRpcServer:
             head_root=bytes.fromhex(h["head_root"]),
             justified_epoch=h["justified_epoch"],
             finalized_epoch=h["finalized_epoch"],
-            peer_count=h["peers"])
+            peer_count=h["peers"],
+            genesis_time=h.get("genesis_time", 0))
 
 
 class ValidatorRpcClient:
@@ -397,4 +398,5 @@ class ValidatorRpcClient:
             "justified_epoch": resp.justified_epoch,
             "finalized_epoch": resp.finalized_epoch,
             "peers": resp.peer_count,
+            "genesis_time": resp.genesis_time,
         }

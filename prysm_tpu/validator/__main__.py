@@ -1,0 +1,85 @@
+"""Standalone validator client binary.
+
+Reference analog: ``cmd/validator`` — the second binary of the
+two-process deployment, speaking the v1alpha1 validator service to a
+beacon node over a socket [U, SURVEY.md §2 "validator client", §3.4].
+
+    python -m prysm_tpu.validator --rpc 127.0.0.1:4000 --keys 16 \
+        --slots 4
+
+connects the typed RPC stub, syncs the slot clock from the node's
+genesis time, and runs the per-slot duty loop (propose / attest /
+aggregate, keymanager signing behind the slashing-protection DB).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="prysm_tpu.validator",
+        description="TPU-native validator client (remote beacon node)")
+    p.add_argument("--rpc", required=True, metavar="HOST:PORT",
+                   help="beacon node validator-RPC endpoint")
+    p.add_argument("--keys", type=int, default=16,
+                   help="deterministic key count (interop keys 0..N-1)")
+    p.add_argument("--slots", type=int, default=4,
+                   help="run the duty loop for this many slots, then "
+                        "exit")
+    p.add_argument("--minimal-config", action="store_true",
+                   default=True)
+    p.add_argument("--protection-db", default=":memory:",
+                   help="slashing-protection DB path (EIP-3076 "
+                        "semantics; ':memory:' for the demo)")
+    args = p.parse_args(argv)
+
+    from ..config import use_minimal_config
+
+    use_minimal_config()
+
+    from ..config import beacon_config
+    from ..rpc import ValidatorRpcClient
+    from .client import ValidatorClient
+    from .keymanager import KeyManager
+    from .protection import SlashingProtectionDB
+
+    host, port_s = args.rpc.rsplit(":", 1)
+    client = ValidatorRpcClient(host, int(port_s))
+    health = client.node_health()
+    genesis_time = health["genesis_time"]
+    spslot = beacon_config().seconds_per_slot
+    print(f"connected: head_slot={health['head_slot']} "
+          f"genesis_time={genesis_time}")
+
+    km = KeyManager.deterministic(args.keys)
+    vc = ValidatorClient(
+        client, km,
+        protection=SlashingProtectionDB(args.protection_db))
+
+    done = 0
+    last = 0
+    while done < args.slots:
+        now = time.time()
+        slot = max(0, int(now - genesis_time) // spslot)
+        if slot > last:
+            last = slot
+            vc.on_slot(slot)
+            done += 1
+            print(f"slot {slot}: proposed={vc.proposed} "
+                  f"attested={vc.attested} "
+                  f"aggregated={vc.aggregated}", flush=True)
+        else:
+            time.sleep(0.2)
+    client.close()
+    print(f"done: proposed={vc.proposed} attested={vc.attested} "
+          f"aggregated={vc.aggregated} "
+          f"refusals={vc.protection_refusals}")
+    return 0 if vc.proposed + vc.attested > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

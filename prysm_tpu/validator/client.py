@@ -2,17 +2,22 @@
 
 Reference analog: ``validator/client.runner`` [U, SURVEY.md §2, §3.4]:
 per-epoch GetDuties, per-slot propose (keymanager sign behind the
-slashing-protection check) and attest flows, aggregation duty.  Runs
-against the in-process ``ValidatorAPI`` (the ✂gRPC boundary of the
-reference collapses to a call).
+slashing-protection check) and attest flows, aggregation duty.
+
+``api`` is anything exposing the ValidatorAPI surface — the
+in-process object or the ``ValidatorRpcClient`` socket stub; the
+runner touches NO node state directly (domains come from
+``api.domain_data``, committee sizes ride the duty), so it runs as a
+separate OS process against a remote beacon node exactly like the
+reference's gRPC validator binary.
 """
 
 from __future__ import annotations
 
 from ..config import beacon_config
 from ..core.helpers import (
-    compute_epoch_at_slot, compute_signing_root, compute_start_slot_at_epoch,
-    get_domain, is_aggregator,
+    compute_epoch_at_slot, compute_signing_root,
+    is_aggregator_for_committee,
 )
 from ..core.transition import _Uint64Box
 from ..crypto.bls import bls
@@ -28,7 +33,10 @@ class ValidatorClient:
         self.api = api
         self.km = keymanager
         self.protection = protection or SlashingProtectionDB()
-        self.types = types or api.node.types
+        if types is None:
+            types = (api.types if hasattr(api, "types")
+                     else api.node.types)
+        self.types = types
         self._duties_epoch: int | None = None
         self._duties = []
         self.proposed = 0
@@ -59,15 +67,14 @@ class ValidatorClient:
 
     def propose(self, slot: int, duty) -> bytes | None:
         cfg = beacon_config()
-        state = self.api.node.chain.head_state
         epoch = compute_epoch_at_slot(slot)
-        randao_domain = get_domain(state, cfg.domain_randao, epoch)
+        randao_domain = self.api.domain_data(epoch, cfg.domain_randao)
         randao = self.km.sign(
             duty.pubkey,
             compute_signing_root(_Uint64Box(epoch), randao_domain))
         block = self.api.get_block_proposal(slot, randao.to_bytes())
 
-        domain = get_domain(state, cfg.domain_beacon_proposer, epoch)
+        domain = self.api.domain_data(epoch, cfg.domain_beacon_proposer)
         root = compute_signing_root(block, domain)
         try:
             self.protection.check_and_record_block(duty.pubkey, slot,
@@ -87,9 +94,8 @@ class ValidatorClient:
     def attest(self, slot: int, duty) -> Attestation | None:
         cfg = beacon_config()
         data = self.api.get_attestation_data(slot, duty.committee_index)
-        state = self.api.node.chain.head_state
-        domain = get_domain(state, cfg.domain_beacon_attester,
-                            data.target.epoch)
+        domain = self.api.domain_data(data.target.epoch,
+                                      cfg.domain_beacon_attester)
         root = compute_signing_root(data, domain)
         try:
             self.protection.check_and_record_attestation(
@@ -109,9 +115,8 @@ class ValidatorClient:
 
     def selection_proof(self, slot: int, pubkey: bytes) -> bls.Signature:
         cfg = beacon_config()
-        state = self.api.node.chain.head_state
-        domain = get_domain(state, cfg.domain_selection_proof,
-                            compute_epoch_at_slot(slot))
+        domain = self.api.domain_data(compute_epoch_at_slot(slot),
+                                      cfg.domain_selection_proof)
         return self.km.sign(pubkey,
                             compute_signing_root(_Uint64Box(slot),
                                                  domain))
@@ -122,10 +127,10 @@ class ValidatorClient:
         from ..proto import AggregateAndProof, SignedAggregateAndProof
 
         cfg = beacon_config()
-        state = self.api.node.chain.head_state
         proof = self.selection_proof(slot, duty.pubkey)
-        if not is_aggregator(state, slot, duty.committee_index,
-                             proof.to_bytes()):
+        # the duty carries the committee, so selection needs no state
+        if not is_aggregator_for_committee(len(duty.committee),
+                                           proof.to_bytes()):
             return None
         aggregate = self.api.get_aggregate_attestation(
             slot, duty.committee_index)
@@ -135,8 +140,8 @@ class ValidatorClient:
             aggregator_index=duty.validator_index,
             aggregate=aggregate,
             selection_proof=proof.to_bytes())
-        domain = get_domain(state, cfg.domain_aggregate_and_proof,
-                            compute_epoch_at_slot(slot))
+        domain = self.api.domain_data(compute_epoch_at_slot(slot),
+                                      cfg.domain_aggregate_and_proof)
         root = compute_signing_root(message, domain)
         signed = SignedAggregateAndProof(
             message=message,
