@@ -31,8 +31,10 @@ def main(argv=None) -> int:
     p.add_argument("--bls-implementation", choices=("pure", "xla"),
                    default="pure",
                    help="BLS backend (north-star feature flag)")
-    p.add_argument("--minimal-config", action="store_true", default=True,
-                   help="use the minimal preset (default for the demo)")
+    p.add_argument("--config", choices=("minimal", "mainnet"),
+                   default="minimal",
+                   help="chain config preset (validator clients must "
+                        "match)")
     p.add_argument("--chain-config-file", default=None,
                    help="YAML overrides for chain constants")
     p.add_argument("--enable-tracing", action="store_true")
@@ -48,11 +50,16 @@ def main(argv=None) -> int:
                         "--rpc-port for --slots slots")
     args = p.parse_args(argv)
 
-    from ..config import (
-        set_features, use_minimal_config,
-    )
+    from ..config import set_features
 
-    use_minimal_config()
+    if args.config == "mainnet":
+        from ..config import use_mainnet_config
+
+        use_mainnet_config()
+    else:
+        from ..config import use_minimal_config
+
+        use_minimal_config()
     if args.chain_config_file:
         from ..config import load_chain_config_file, use_config
 
@@ -64,7 +71,7 @@ def main(argv=None) -> int:
 
         enable_tracing(True)
 
-    from ..config import MINIMAL_CONFIG
+    from ..config import beacon_config
     from ..proto import build_types
     from ..testing.util import (
         deterministic_genesis_state, generate_full_block,
@@ -73,7 +80,7 @@ def main(argv=None) -> int:
     from ..p2p import GossipBus, TOPIC_BLOCK
     from .node import BeaconNode
 
-    types = build_types(MINIMAL_CONFIG)
+    types = build_types(beacon_config())
     genesis = deterministic_genesis_state(args.validators, types)
     genesis.genesis_time = int(time.time())
 

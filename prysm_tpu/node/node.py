@@ -115,10 +115,11 @@ class BeaconNode:
                                  time.perf_counter() - t0)
             if not ok:
                 self.metrics.inc("slot_batch_failures")
-        if (self.shards is not None and slot > 0
-                and slot % cfg.slots_per_epoch == 0):
-            # epoch boundary: advance the crosslink sidecar from the
-            # head state's attestation view
+        if self.shards is not None and slot > 0:
+            # every tick: the service advances its crosslink sidecar
+            # only when the HEAD STATE's epoch has actually crossed
+            # (tick-timing-independent — a lagging head defers the
+            # advance until the boundary block arrives)
             self.shards.on_epoch_boundary(self.chain.head_state)
         retention = cfg.slots_per_epoch
         if slot > retention:

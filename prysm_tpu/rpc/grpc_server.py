@@ -285,6 +285,11 @@ class ValidatorRpcClient:
                 # one reconnect: the server may have dropped an idle
                 # keep-alive connection
                 resp = self._roundtrip(body)
+        if not resp:
+            # a zero-length response frame (buggy/hostile server) must
+            # surface through the protocol's typed error path, not as
+            # an IndexError
+            raise RpcError(INTERNAL, "empty response frame")
         status, payload = resp[0], resp[1:]
         if status != OK:
             err = pb.Error.FromString(payload)

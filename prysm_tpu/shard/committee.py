@@ -44,6 +44,17 @@ def get_start_shard(state, epoch: int, cfg=None) -> int:
     with registry churn), and the epoch index times the current delta
     modulo the ring gives the same steady rotation.  Deterministic for
     all nodes evaluating the same state.
+
+    Fairness caveat (round-4 advisor): across a registry-churn epoch
+    where the committee count changes, ``start(e+1) !=
+    start(e) + delta(e)`` — the rotation is discontinuous, so some
+    shards are skipped and others crosslinked twice at the
+    transition.  All nodes compute the SAME discontinuity (consensus
+    is unaffected); only per-shard crosslink cadence is momentarily
+    uneven.  A cumulative derivation (sum of per-epoch deltas anchored
+    at a checkpoint) would restore contiguity at the cost of an
+    unbounded walk over historical states; this design era accepts
+    the cadence blip instead.
     """
     cfg = cfg or beacon_config()
     return (epoch * get_shard_delta(state, epoch, cfg)) % cfg.shard_count

@@ -105,9 +105,14 @@ def get_winning_crosslink_and_attesting_indices(
     mask &= ~slashed[None, :]
     stakes = (mask * balances[None, :]).sum(axis=1)
 
+    # spec key: (stake, data_root); the full HTR is appended as a
+    # FINAL disambiguator so distinct candidates that tie on both
+    # stake and data_root still order totally (arrival-order
+    # independence across nodes), without changing the spec ordering
+    # whenever data_root differs
     best = max(
         range(len(candidates)),
-        key=lambda ci: (int(stakes[ci]),
+        key=lambda ci: (int(stakes[ci]), candidates[ci][0].data_root,
                         Crosslink.hash_tree_root(candidates[ci][0])))
     link, inds = candidates[best]
     unslashed = {v for v in inds if not state.validators[v].slashed}
@@ -127,15 +132,26 @@ def process_crosslinks(state, store: CrosslinkStore,
     that advanced.
     """
     cfg = cfg or beacon_config()
-    store.previous = [Crosslink(**{k: getattr(c, k) for k, _ in
-                                   Crosslink.fields})
-                      for c in store.current]
+    # TRANSACTIONAL: all evaluation runs on a staged copy; the real
+    # store is touched only after every shard evaluated cleanly.  A
+    # mid-run exception (malformed pooled entry, transient state
+    # error) previously left store.previous overwritten and
+    # store.current partially advanced — a retrying caller then
+    # diverged from nodes that processed cleanly (round-5 review).
+    # shallow copies suffice: Crosslink objects are never mutated in
+    # place (list slots are only replaced), and sharing them keeps
+    # their memoized hash_tree_roots
+    staged = CrosslinkStore(
+        shard_count=store.shard_count,
+        current=list(store.current),
+        previous=list(store.current))
     committed: dict[int, Crosslink] = {}
     current_epoch = helpers.get_current_epoch(state)
     previous_epoch = helpers.get_previous_epoch(state)
     # spec order matters: previous epoch FIRST, then current — a
     # current-epoch advance must not orphan previous-epoch candidates
-    # whose parent is the pre-advance record
+    # whose parent is the pre-advance record (the staged store mutates
+    # as the loop runs, exactly like the spec's in-state arrays)
     epochs = ([previous_epoch, current_epoch]
               if previous_epoch != current_epoch else [current_epoch])
     for epoch in epochs:
@@ -150,13 +166,15 @@ def process_crosslinks(state, store: CrosslinkStore,
                 continue
             winner, attesting = \
                 get_winning_crosslink_and_attesting_indices(
-                    state, store, epoch, shard,
+                    state, staged, epoch, shard,
                     attestations_for(epoch, shard), cfg)
             committee_stake = helpers.get_total_balance(state, cmte, cfg)
             attesting_stake = helpers.get_total_balance(
                 state, attesting, cfg)
             if attesting_stake * 3 >= committee_stake * 2 \
                     and winner.end_epoch != 0:
-                store.current[shard] = winner
+                staged.current[shard] = winner
                 committed[shard] = winner
+    store.current = staged.current
+    store.previous = staged.previous
     return committed

@@ -58,6 +58,9 @@ class ShardService:
         self._head: dict[int, bytes] = {}
         # (epoch, shard) -> list[(Crosslink, attesting_indices)]
         self._cl_atts: dict[tuple[int, int], list] = defaultdict(list)
+        # last head-state epoch whose boundary processing ran (epoch 0
+        # needs no crosslink advance, so 0 is the correct floor)
+        self._last_epoch = 0
         self._lock = threading.RLock()
 
     # --- chain maintenance -------------------------------------------------
@@ -212,13 +215,32 @@ class ShardService:
             return list(self._cl_atts.get((epoch, shard), ()))
 
     def on_epoch_boundary(self, state) -> dict[int, Crosslink]:
-        """Advance the crosslink store (epoch processing hook, called
-        by the blockchain service on epoch transitions when the
-        feature is on)."""
+        """Advance the crosslink store when the HEAD STATE's epoch has
+        actually crossed — not merely when the wall-clock tick lands on
+        an epoch boundary.  Nodes whose heads lag (boundary block not
+        yet arrived) would otherwise advance their CrosslinkStores at
+        different effective epochs, splitting crosslink parent_roots
+        across nodes so 2/3 votes never accumulate (round-4 advisor
+        finding).  Tick-driven callers may invoke this every slot; it
+        is a no-op until ``get_current_epoch(head_state)`` advances."""
         with self._lock:
+            cur = helpers.get_current_epoch(state)
+            if cur <= self._last_epoch:
+                return {}
             committed = process_crosslinks(
                 state, self.store, self.attestations_for, self.cfg)
-            cur = helpers.get_current_epoch(state)
+            # advance the marker only after processing succeeds — a
+            # transient failure above leaves it unset AND leaves the
+            # store untouched (process_crosslinks stages all mutations
+            # and commits atomically), so the next tick is a clean
+            # retry, not a replay over partial state
+            self._last_epoch = cur
+            # prune pool entries older than the spec's inclusion
+            # window (previous epoch).  On a multi-epoch head jump
+            # (e.g. sync catch-up 1 -> 3) the skipped epochs' entries
+            # are dropped unprocessed — matching the spec: a state at
+            # epoch E can only count epoch E-1/E attestations, so
+            # those votes are unincludable by construction
             for key in [k for k in self._cl_atts if k[0] < cur - 1]:
                 del self._cl_atts[key]
             return committed

@@ -30,16 +30,22 @@ def main(argv=None) -> int:
     p.add_argument("--slots", type=int, default=4,
                    help="run the duty loop for this many slots, then "
                         "exit")
-    p.add_argument("--minimal-config", action="store_true",
-                   default=True)
+    p.add_argument("--config", choices=("minimal", "mainnet"),
+                   default="minimal",
+                   help="chain config preset (must match the node's)")
     p.add_argument("--protection-db", default=":memory:",
                    help="slashing-protection DB path (EIP-3076 "
                         "semantics; ':memory:' for the demo)")
     args = p.parse_args(argv)
 
-    from ..config import use_minimal_config
+    if args.config == "mainnet":
+        from ..config import use_mainnet_config
 
-    use_minimal_config()
+        use_mainnet_config()
+    else:
+        from ..config import use_minimal_config
+
+        use_minimal_config()
 
     from ..config import beacon_config
     from ..rpc import ValidatorRpcClient
