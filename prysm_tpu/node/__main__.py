@@ -3,7 +3,8 @@
 Reference analog: ``cmd/beacon-chain`` urfave/cli flags [U, SURVEY.md
 §2 "binaries/CLI", §5 "Config/flags"]; notable parity flags:
 ``--bls-implementation={pure,xla}`` (the north-star selector),
-``--minimal-config``, ``--enable-tracing``.
+``--config={minimal,mainnet}``, ``--enable-tracing``,
+``--rpc-carrier={grpc,framed}``.
 
 ``python -m prysm_tpu.node --nodes 2 --slots 4`` spins up N in-process
 nodes on a fake gossip bus (epochs of seconds, minimal preset),
@@ -41,8 +42,12 @@ def main(argv=None) -> int:
     p.add_argument("--metrics", action="store_true",
                    help="print the /metrics exposition at the end")
     p.add_argument("--rpc-port", type=int, default=None,
-                   help="serve the v1alpha1 validator RPC (framed "
-                        "protobuf over TCP) for node 0 on this port")
+                   help="serve the v1alpha1 validator RPC for node 0 "
+                        "on this port")
+    p.add_argument("--rpc-carrier", choices=("grpc", "framed"),
+                   default="grpc",
+                   help="RPC transport: real gRPC (default) or the "
+                        "dependency-free framed-TCP fallback")
     p.add_argument("--serve", action="store_true",
                    help="wall-clock mode: no scripted proposals; an "
                         "external validator client (python -m "
@@ -94,22 +99,40 @@ def main(argv=None) -> int:
 
     rpc_server = None
     if args.rpc_port is not None:
-        from ..rpc import ValidatorAPI, ValidatorRpcServer
+        if args.rpc_carrier == "grpc":
+            from ..rpc import GrpcValidatorServer, ValidatorAPI
 
-        rpc_server = ValidatorRpcServer(ValidatorAPI(nodes[0]),
-                                        port=args.rpc_port)
+            rpc_server = GrpcValidatorServer(ValidatorAPI(nodes[0]),
+                                             port=args.rpc_port)
+        else:
+            from ..rpc import ValidatorAPI, ValidatorRpcServer
+
+            rpc_server = ValidatorRpcServer(ValidatorAPI(nodes[0]),
+                                            port=args.rpc_port)
         rpc_server.start()
-        print(f"validator RPC on {rpc_server.host}:{rpc_server.port}",
-              flush=True)
+        print(f"validator RPC ({args.rpc_carrier}) on "
+              f"{rpc_server.host}:{rpc_server.port}", flush=True)
 
     if args.serve:
         # wall-clock mode: duties arrive over RPC from an external
-        # validator process (the reference's two-binary deployment)
+        # validator process (the reference's two-binary deployment).
+        # Progress-aware window: a fixed deadline raced the validator
+        # process's interpreter/jax startup on busy hosts and could
+        # tear the RPC server down mid-duty-loop; instead serve until
+        # the head reaches --slots (plus one slot of grace for the
+        # validator's trailing attestation/aggregate submissions),
+        # bounded by a generous hard cap.
         from ..config import beacon_config
 
         spslot = beacon_config().seconds_per_slot
-        deadline = genesis.genesis_time + (args.slots + 1) * spslot
-        while time.time() < deadline:
+        hard_cap = time.time() + (args.slots + 2) * spslot + 90
+        reached_at = None
+        while time.time() < hard_cap:
+            if reached_at is None:
+                if nodes[0].head_slot() >= args.slots:
+                    reached_at = time.time()
+            elif time.time() - reached_at >= spslot:
+                break
             time.sleep(0.25)
         heads = {n.node_id: n.head_slot() for n in nodes}
         print(f"serve window over: heads={heads}")

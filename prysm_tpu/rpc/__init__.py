@@ -9,6 +9,16 @@ from .http_server import BeaconHTTPServer
 from .grpc_server import (
     RpcError, ValidatorRpcClient, ValidatorRpcServer,
 )
+try:                                    # real-gRPC carrier (production)
+    from .grpc_real import (
+        GrpcValidatorClient, GrpcValidatorServer, wait_for_grpc,
+    )
+except ImportError:                     # pragma: no cover - no grpcio:
+    GrpcValidatorClient = None          # the framed fallback carrier
+    GrpcValidatorServer = None          # above stays fully usable
+    wait_for_grpc = None
 
 __all__ = ["ValidatorAPI", "APIError", "BeaconHTTPServer",
-           "RpcError", "ValidatorRpcClient", "ValidatorRpcServer"]
+           "RpcError", "ValidatorRpcClient", "ValidatorRpcServer",
+           "GrpcValidatorClient", "GrpcValidatorServer",
+           "wait_for_grpc"]

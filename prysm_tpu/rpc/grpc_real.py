@@ -1,0 +1,136 @@
+"""Real gRPC carrier for the v1alpha1 ``BeaconNodeValidator`` service.
+
+Reference analog: ``beacon-chain/rpc/service.go`` registering the
+v1alpha1 servicers on a ``grpc.Server``, and the validator client's
+generated stubs dialing it [U, SURVEY.md §2 "RPC", §3.4].
+
+grpcio has no generated servicer here (grpc_tools isn't installed to
+regenerate from ``proto/v1alpha1.proto``), so the server registers the
+carrier-independent ``ServiceHandlers`` table through grpc's generic
+handler API — the wire contract (full method paths, protobuf payloads,
+status codes) is exactly what a generated servicer would expose, and
+the client side uses ``channel.unary_unary`` multicallables the same
+way generated stubs do internally.
+
+Status-code mapping: the framed carrier's integer codes are the gRPC
+code values themselves (grpc_server.OK/INVALID_ARGUMENT/NOT_FOUND/
+INTERNAL), so errors translate 1:1 in both directions and callers see
+one ``RpcError`` surface regardless of carrier.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+import grpc
+
+from .api import APIError
+from .grpc_server import (
+    INTERNAL, SERVICE, RpcError, ServiceHandlers, ValidatorRpcClient,
+)
+
+_SERVICE_NAME = SERVICE.strip("/").rsplit("/", 1)[0]
+
+_CODE_TO_GRPC = {c.value[0]: c for c in grpc.StatusCode}
+
+
+def _to_grpc_code(code: int) -> grpc.StatusCode:
+    return _CODE_TO_GRPC.get(code, grpc.StatusCode.UNKNOWN)
+
+
+class GrpcValidatorServer:
+    """``BeaconNodeValidator`` on a real ``grpc.Server`` (HTTP/2).
+
+    Same lifecycle surface as the framed ``ValidatorRpcServer``
+    (start/stop/host/port) so node assembly can swap carriers."""
+
+    def __init__(self, api, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 8):
+        self.api = api
+        self.handlers = ServiceHandlers(api)
+        method_handlers = {
+            name: grpc.unary_unary_rpc_method_handler(self._wrap(fn))
+            for name, fn in self.handlers.table.items()
+        }
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers,
+                                       thread_name_prefix="grpc-rpc"))
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(_SERVICE_NAME,
+                                                 method_handlers),))
+        self.host = host
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise OSError(f"could not bind gRPC server on {host}:{port}")
+
+    @staticmethod
+    def _wrap(fn):
+        """bytes-in/bytes-out unary handler: no (de)serializer is
+        registered with grpc, so ``request`` arrives as raw payload
+        bytes and the handler's protobuf response is serialized here —
+        the same framing the generated servicer would produce."""
+
+        def call(request: bytes, context: grpc.ServicerContext) -> bytes:
+            try:
+                return fn(request).SerializeToString()
+            except RpcError as e:
+                context.abort(_to_grpc_code(e.code), str(e))
+            except APIError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except Exception as e:              # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+
+        return call
+
+    # --- lifecycle (ValidatorRpcServer-compatible) --------------------------
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float | None = 1.0) -> None:
+        self._server.stop(grace)
+
+
+class GrpcValidatorClient(ValidatorRpcClient):
+    """The validator client's stub over a real gRPC channel.
+
+    Inherits every typed mirror method (get_duties, get_block, ...)
+    from ``ValidatorRpcClient`` and replaces only the transport:
+    ``_call`` goes through a ``channel.unary_unary`` multicallable
+    instead of the framed socket.  grpc.RpcError surfaces as the same
+    typed ``RpcError`` the framed client raises."""
+
+    def __init__(self, host: str, port: int, types=None,
+                 timeout: float = 10.0):
+        super().__init__(host, port, types=types, timeout=timeout)
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        self._multicallables: dict[str, grpc.UnaryUnaryMultiCallable] = {}
+
+    def _call(self, method: str, req, resp_type):
+        mc = self._multicallables.get(method)
+        if mc is None:
+            # no (de)serializers: send/receive raw protobuf bytes,
+            # typed below — mirrors the server's generic handlers
+            mc = self._channel.unary_unary(SERVICE + method)
+            self._multicallables[method] = mc
+        try:
+            data = mc(req.SerializeToString(), timeout=self._timeout)
+        except grpc.RpcError as e:
+            code = e.code()
+            raise RpcError(
+                code.value[0] if code is not None else INTERNAL,
+                e.details() or "transport error") from None
+        return resp_type.FromString(data)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def wait_for_grpc(host: str, port: int, timeout: float = 10.0) -> None:
+    """Block until the server's channel is READY (2-process tests)."""
+    channel = grpc.insecure_channel(f"{host}:{port}")
+    try:
+        grpc.channel_ready_future(channel).result(timeout=timeout)
+    finally:
+        channel.close()

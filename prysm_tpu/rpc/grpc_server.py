@@ -1,14 +1,17 @@
-"""gRPC-semantics RPC: the v1alpha1 validator service over TCP.
+"""v1alpha1 validator service: carrier-independent handlers + the
+framed-TCP fallback carrier.
 
 Reference analog: ``beacon-chain/rpc`` serving the protobuf
 ``BeaconNodeValidator`` service over gRPC, consumed by the validator
-client's stubs [U, SURVEY.md §2 "RPC", §3.4].  This carrier keeps the
-three things that make it "gRPC semantics" — a protobuf-defined
-service contract (``proto/v1alpha1.proto``), full-method-path
-dispatch (``/prysm_tpu.v1alpha1.BeaconNodeValidator/GetDuties``), and
-typed status codes on error — over a framed TCP protocol instead of
-HTTP/2 (no grpcio in this environment; the frame layer is ~40 lines
-and the contract is identical).
+client's stubs [U, SURVEY.md §2 "RPC", §3.4].  The PRODUCTION carrier
+is real gRPC over HTTP/2 (``grpc_real`` — grpcio is available in this
+environment); ``ServiceHandlers`` holds the contract logic both
+carriers share.  This module's framed-TCP carrier remains as the
+dependency-free fallback and the wire-robustness probe target — its
+three gRPC-semantics properties (protobuf contract from
+``proto/v1alpha1.proto``, full-method-path dispatch
+``/prysm_tpu.v1alpha1.BeaconNodeValidator/GetDuties``, typed status
+codes) are identical to the real carrier's.
 
 Frame format (all little-endian):
   request:  u32 total_len | u16 method_len | method utf-8 | payload
@@ -67,12 +70,16 @@ def _recv_frame(sock: socket.socket) -> bytes:
     return _recv_exact(sock, total)
 
 
-class ValidatorRpcServer:
-    """Serves a ``ValidatorAPI`` over the framed protobuf protocol."""
+class ServiceHandlers:
+    """The ``BeaconNodeValidator`` method table, carrier-independent:
+    each handler takes the request payload bytes and returns the
+    response protobuf message.  Shared by the framed-TCP server below
+    and the real-gRPC server (``grpc_real.GrpcValidatorServer``), so
+    both carriers serve byte-identical contract semantics."""
 
-    def __init__(self, api, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, api):
         self.api = api
-        self._handlers = {
+        self.table = {
             "GetDuties": self._get_duties,
             "GetBlock": self._get_block,
             "ProposeBlock": self._propose_block,
@@ -83,66 +90,6 @@ class ValidatorRpcServer:
             "DomainData": self._domain_data,
             "GetHealth": self._get_health,
         }
-        outer = self
-
-        class _Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                try:
-                    while True:
-                        frame = _recv_frame(self.request)
-                        resp = outer._dispatch(frame)
-                        _send_frame(self.request, resp)
-                except (ConnectionError, OSError):
-                    return
-
-        class _Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = _Server((host, port), _Handler)
-        self.host, self.port = self._server.server_address
-        self._thread: threading.Thread | None = None
-
-    # --- lifecycle ---------------------------------------------------------
-
-    def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="validator-rpc")
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-
-    # --- dispatch ----------------------------------------------------------
-
-    def _dispatch(self, frame: bytes) -> bytes:
-        try:
-            (mlen,) = struct.unpack_from("<H", frame)
-            method = frame[2:2 + mlen].decode()
-            payload = frame[2 + mlen:]
-        except Exception:
-            return self._error(INVALID_ARGUMENT, "malformed frame")
-        if not method.startswith(SERVICE):
-            return self._error(NOT_FOUND, f"unknown service: {method}")
-        handler = self._handlers.get(method[len(SERVICE):])
-        if handler is None:
-            return self._error(NOT_FOUND, f"unknown method: {method}")
-        try:
-            msg = handler(payload)
-            return bytes([OK]) + msg.SerializeToString()
-        except RpcError as e:
-            return self._error(e.code, str(e))
-        except APIError as e:
-            return self._error(INVALID_ARGUMENT, str(e))
-        except Exception as e:                  # noqa: BLE001
-            return self._error(INTERNAL, f"{type(e).__name__}: {e}")
-
-    @staticmethod
-    def _error(code: int, message: str) -> bytes:
-        err = pb.Error(message=message, code=code)
-        return bytes([code & 0xFF]) + err.SerializeToString()
 
     # --- handlers ----------------------------------------------------------
 
@@ -231,6 +178,81 @@ class ValidatorRpcServer:
             finalized_epoch=h["finalized_epoch"],
             peer_count=h["peers"],
             genesis_time=h.get("genesis_time", 0))
+
+
+class ValidatorRpcServer:
+    """Serves a ``ValidatorAPI`` over the framed protobuf protocol.
+
+    The production carrier is real gRPC (``grpc_real``); this framed
+    server stays as the dependency-free fallback and as the probe
+    target for wire-level robustness tests (malformed frames, empty
+    responses) that grpc's own transport would reject before our code
+    sees them."""
+
+    def __init__(self, api, host: str = "127.0.0.1", port: int = 0):
+        self.api = api
+        self.handlers = ServiceHandlers(api)
+        self._handlers = self.handlers.table
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        frame = _recv_frame(self.request)
+                        resp = outer._dispatch(frame)
+                        _send_frame(self.request, resp)
+                except (ConnectionError, OSError):
+                    return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="validator-rpc")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # --- dispatch ----------------------------------------------------------
+
+    def _dispatch(self, frame: bytes) -> bytes:
+        try:
+            (mlen,) = struct.unpack_from("<H", frame)
+            method = frame[2:2 + mlen].decode()
+            payload = frame[2 + mlen:]
+        except Exception:
+            return self._error(INVALID_ARGUMENT, "malformed frame")
+        if not method.startswith(SERVICE):
+            return self._error(NOT_FOUND, f"unknown service: {method}")
+        handler = self._handlers.get(method[len(SERVICE):])
+        if handler is None:
+            return self._error(NOT_FOUND, f"unknown method: {method}")
+        try:
+            msg = handler(payload)
+            return bytes([OK]) + msg.SerializeToString()
+        except RpcError as e:
+            return self._error(e.code, str(e))
+        except APIError as e:
+            return self._error(INVALID_ARGUMENT, str(e))
+        except Exception as e:                  # noqa: BLE001
+            return self._error(INTERNAL, f"{type(e).__name__}: {e}")
+
+    @staticmethod
+    def _error(code: int, message: str) -> bytes:
+        err = pb.Error(message=message, code=code)
+        return bytes([code & 0xFF]) + err.SerializeToString()
 
 
 class ValidatorRpcClient:

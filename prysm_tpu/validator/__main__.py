@@ -33,6 +33,10 @@ def main(argv=None) -> int:
     p.add_argument("--config", choices=("minimal", "mainnet"),
                    default="minimal",
                    help="chain config preset (must match the node's)")
+    p.add_argument("--rpc-carrier", choices=("grpc", "framed"),
+                   default="grpc",
+                   help="RPC transport: real gRPC (default) or the "
+                        "dependency-free framed-TCP fallback")
     p.add_argument("--protection-db", default=":memory:",
                    help="slashing-protection DB path (EIP-3076 "
                         "semantics; ':memory:' for the demo)")
@@ -48,13 +52,19 @@ def main(argv=None) -> int:
         use_minimal_config()
 
     from ..config import beacon_config
-    from ..rpc import ValidatorRpcClient
     from .client import ValidatorClient
     from .keymanager import KeyManager
     from .protection import SlashingProtectionDB
 
     host, port_s = args.rpc.rsplit(":", 1)
-    client = ValidatorRpcClient(host, int(port_s))
+    if args.rpc_carrier == "grpc":
+        from ..rpc import GrpcValidatorClient
+
+        client = GrpcValidatorClient(host, int(port_s))
+    else:
+        from ..rpc import ValidatorRpcClient
+
+        client = ValidatorRpcClient(host, int(port_s))
     health = client.node_health()
     genesis_time = health["genesis_time"]
     spslot = beacon_config().seconds_per_slot

@@ -1,8 +1,10 @@
-"""gRPC-semantics RPC tests: protobuf v1alpha1 service over TCP.
+"""v1alpha1 validator-RPC tests over BOTH carriers.
 
-A live node serves ``ValidatorRpcServer``; a ``ValidatorRpcClient``
-stub (mirroring ValidatorAPI's signatures) drives duties, block
-production, and the attestation flow across a real socket."""
+A live node serves the ``BeaconNodeValidator`` contract; the typed
+client stub drives duties, block production, and the attestation flow
+across a real socket.  The surface tests are parametrized over the
+real-gRPC carrier (production) and the framed-TCP fallback — the
+contract must behave identically on both."""
 
 import socket
 import struct
@@ -13,7 +15,8 @@ from prysm_tpu.config import use_mainnet_config, use_minimal_config
 from prysm_tpu.p2p import GossipBus
 from prysm_tpu.proto import build_types
 from prysm_tpu.rpc import (
-    RpcError, ValidatorAPI, ValidatorRpcClient, ValidatorRpcServer,
+    GrpcValidatorClient, GrpcValidatorServer, RpcError, ValidatorAPI,
+    ValidatorRpcClient, ValidatorRpcServer,
 )
 from prysm_tpu.rpc.grpc_server import (
     INVALID_ARGUMENT, NOT_FOUND, SERVICE, _recv_frame, _send_frame,
@@ -35,16 +38,39 @@ def types():
     return build_types(MINIMAL_CONFIG)
 
 
-@pytest.fixture()
-def rig(types):
+def _make_rig(types, carrier: str):
     from prysm_tpu.node import BeaconNode
 
     genesis = testutil.deterministic_genesis_state(16, types)
     bus = GossipBus()
     node = BeaconNode(bus, "rpc-node", genesis, types=types)
-    server = ValidatorRpcServer(ValidatorAPI(node))
-    server.start()
-    client = ValidatorRpcClient(server.host, server.port, types=types)
+    if carrier == "grpc":
+        server = GrpcValidatorServer(ValidatorAPI(node))
+        server.start()
+        client = GrpcValidatorClient(server.host, server.port,
+                                     types=types)
+    else:
+        server = ValidatorRpcServer(ValidatorAPI(node))
+        server.start()
+        client = ValidatorRpcClient(server.host, server.port,
+                                    types=types)
+    return node, server, client
+
+
+@pytest.fixture(params=["grpc", "framed"])
+def rig(request, types):
+    node, server, client = _make_rig(types, request.param)
+    yield node, server, client
+    client.close()
+    server.stop()
+    node.stop()
+
+
+@pytest.fixture()
+def framed_rig(types):
+    """Framed-TCP carrier only — for wire-level probes that grpc's
+    HTTP/2 transport would reject before our code sees them."""
+    node, server, client = _make_rig(types, "framed")
     yield node, server, client
     client.close()
     server.stop()
@@ -173,11 +199,12 @@ class TestRemoteDutyRunner:
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("carrier", ["grpc", "framed"])
 class TestTwoProcessDeployment:
-    def test_node_and_validator_binaries(self, tmp_path):
+    def test_node_and_validator_binaries(self, tmp_path, carrier):
         """Real two-OS-process deployment: beacon node serving the
-        framed-protobuf RPC, validator binary driving duties over the
-        socket."""
+        v1alpha1 RPC (real gRPC by default), validator binary driving
+        duties over it."""
         import subprocess
         import sys as _sys
         import os
@@ -192,25 +219,29 @@ class TestTwoProcessDeployment:
                    PYTHONPATH="/root/repo")
         node_proc = subprocess.Popen(
             [_sys.executable, "-m", "prysm_tpu.node", "--nodes", "1",
-             "--validators", "8", "--slots", "3", "--serve",
-             "--rpc-port", str(port)],
+             "--validators", "8", "--slots", "2", "--serve",
+             "--rpc-port", str(port), "--rpc-carrier", carrier],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env, cwd="/root/repo")
         try:
-            # wait for the RPC banner
+            # wait for the RPC banner, then (grpc) for channel READY
             for line in node_proc.stdout:
-                if "validator RPC on" in line:
+                if "validator RPC" in line:
                     break
+            if carrier == "grpc":
+                from prysm_tpu.rpc import wait_for_grpc
+
+                wait_for_grpc("127.0.0.1", port, timeout=30)
             val = subprocess.run(
                 [_sys.executable, "-m", "prysm_tpu.validator",
                  "--rpc", f"127.0.0.1:{port}", "--keys", "8",
-                 "--slots", "2"],
+                 "--slots", "2", "--rpc-carrier", carrier],
                 capture_output=True, text=True, timeout=120, env=env,
                 cwd="/root/repo")
             assert val.returncode == 0, val.stdout + val.stderr
             m = re.search(r"proposed=(\d+)", val.stdout.splitlines()[-1])
             assert m and int(m.group(1)) >= 1, val.stdout
-            out, _ = node_proc.communicate(timeout=60)
+            out, _ = node_proc.communicate(timeout=150)
             assert "consensus: OK" in out, out
         finally:
             if node_proc.poll() is None:
@@ -230,26 +261,26 @@ class TestWireProtocol:
         finally:
             sock.close()
 
-    def test_unknown_method_not_found(self, rig):
-        _node, server, _client = rig
+    def test_unknown_method_not_found(self, framed_rig):
+        _node, server, _client = framed_rig
         status, _ = self._raw_call(server, SERVICE + "NoSuchMethod")
         assert status == NOT_FOUND
 
-    def test_unknown_service_not_found(self, rig):
-        _node, server, _client = rig
+    def test_unknown_service_not_found(self, framed_rig):
+        _node, server, _client = framed_rig
         status, _ = self._raw_call(server, "/other.Service/Method")
         assert status == NOT_FOUND
 
-    def test_garbage_payload_is_invalid_not_crash(self, rig):
-        _node, server, client = rig
+    def test_garbage_payload_is_invalid_not_crash(self, framed_rig):
+        _node, server, client = framed_rig
         status, _ = self._raw_call(server, SERVICE + "GetDuties",
                                    b"\xff\xff\xff\xff\xff")
         assert status != 0
         # server still serves afterwards
         assert client.node_health()["head_slot"] >= 0
 
-    def test_oversized_frame_closes_connection(self, rig):
-        _node, server, _client = rig
+    def test_oversized_frame_closes_connection(self, framed_rig):
+        _node, server, _client = framed_rig
         sock = socket.create_connection((server.host, server.port),
                                         timeout=5)
         try:
