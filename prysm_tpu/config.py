@@ -201,6 +201,7 @@ class FeatureFlags:
     enable_tracing: bool = False
     slot_batch_verify: bool = True
     shard_chains: bool = False
+    slasher: bool = False
     extra: dict = field(default_factory=dict)
 
 

@@ -204,6 +204,101 @@ class SignatureBatch:
         return verify_multiple_signatures(self, rng=rng)
 
 
+class PubkeyTable:
+    """Registry-wide packed pubkey table, device-resident, append-only.
+
+    Reference analog: the per-validator deserialized-pubkey cache the
+    reference keeps beside its registry [U, SURVEY.md §3.3] — here the
+    WHOLE registry lives on device as Montgomery-affine coordinate
+    arrays, so per-slot verification gathers signer rows by INDEX and
+    aggregates on device (xla/verify.indexed_slot_verify_device)
+    instead of running pure-Python EC math per signer.
+
+    ``sync`` decompresses only the registry suffix added since the
+    last call — one batched device dispatch per deposit batch, zero
+    work on the steady path.  The eth2 registry is append-only, so a
+    (node-local) table serves every state of the chain.  Invalid or
+    infinity pubkeys mark their row ``inf``: such a signer aggregates
+    as the identity, which makes its attestation FAIL verification
+    (fail-closed) rather than be skipped.
+
+    Arrays are bucketed to powers of two so the verify graph recompiles
+    O(log N) times over a registry's lifetime, not per deposit."""
+
+    def __init__(self):
+        self.n = 0
+        self._cap = 0
+        self._x = None            # jnp (cap, 24) Montgomery affine
+        self._y = None
+        self._inf = None          # jnp (cap,) bool; padding rows True
+        # reorg sentinel: pubkey bytes of the last synced validator.
+        # Registry appends are fork-local, so a head switch between
+        # forks with different deposit tails can change index->pubkey
+        # at the SAME length; the tail check catches that and triggers
+        # a rebuild (a mid-registry divergence at equal length AND
+        # equal tail is impossible for append-only registries).
+        self._tail = None
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def sync(self, validators) -> None:
+        n = len(validators)
+        if n == 0:
+            return
+        if self.n > 0:
+            stale = (n < self.n
+                     or bytes(validators[self.n - 1].pubkey)
+                     != self._tail)
+            if stale:
+                # cross-fork head switch changed the registry under
+                # us: rebuild from scratch (rare — deposit-tail reorg)
+                self.reset()
+                return self.sync(validators)
+        if n <= self.n:
+            return
+        from .xla import limbs as L
+        from .xla.compress import g1_decompress_batch
+
+        import jax.numpy as jnp
+
+        pubs = [bytes(validators[i].pubkey) for i in range(self.n, n)]
+        nb = _bucket(len(pubs))
+        inf_enc = bytes([0xC0]) + b"\x00" * 47
+        jac, ok = g1_decompress_batch(
+            pubs + [inf_enc] * (nb - len(pubs)))
+        X, Y, Z = jac
+        inf = jnp.asarray(~np.asarray(ok)) | L.fp_is_zero(Z)
+        X, Y, inf = X[:len(pubs)], Y[:len(pubs)], inf[:len(pubs)]
+        cap = _bucket(n)
+        if cap != self._cap or self._x is None:
+            old_x = (self._x[:self.n] if self._x is not None
+                     else jnp.zeros((0, L.NLIMBS), jnp.uint32))
+            old_y = (self._y[:self.n] if self._y is not None
+                     else jnp.zeros((0, L.NLIMBS), jnp.uint32))
+            old_inf = (self._inf[:self.n] if self._inf is not None
+                       else jnp.zeros((0,), bool))
+            grow = cap - self.n - len(pubs)
+            self._x = jnp.concatenate(
+                [old_x, X, jnp.zeros((grow, L.NLIMBS), jnp.uint32)])
+            self._y = jnp.concatenate(
+                [old_y, Y, jnp.zeros((grow, L.NLIMBS), jnp.uint32)])
+            self._inf = jnp.concatenate(
+                [old_inf, inf, jnp.ones((grow,), bool)])
+            self._cap = cap
+        else:
+            sl = slice(self.n, self.n + len(pubs))
+            self._x = self._x.at[sl].set(X)
+            self._y = self._y.at[sl].set(Y)
+            self._inf = self._inf.at[sl].set(inf)
+        self.n = n
+        self._tail = bytes(validators[n - 1].pubkey)
+
+    def arrays(self):
+        """(x, y, inf) device arrays, bucketed capacity."""
+        return self._x, self._y, self._inf
+
+
 def verify_multiple_signatures(batch: SignatureBatch, rng=None) -> bool:
     """Randomized-linear-combination batch verify (reference
     crypto/bls VerifyMultipleSignatures [U]): sound up to 2^-63 per
@@ -427,32 +522,55 @@ def build_synthetic_slot_batch(n_committees: int, committee_size: int,
         except Exception:
             os.remove(cache_path)   # truncated/corrupt: regenerate
 
-    pk_pts, sig_pts, h_pts = [], [], []
-    for c in range(n_committees):
-        msg = hashlib.sha256(b"attestation-root-%d" % c).digest()
-        sks = [ps.deterministic_secret_key(c * committee_size + i)
-               for i in range(committee_size)]
-        # one signer's sig scaled by the sum of secret keys equals the
-        # aggregate: sigma = [sum sk_i] H(m) — build it cheaply with a
-        # single pure scalar-mul instead of committee_size signs
-        total = sum(sks) % R
-        from .pure.hash_to_curve import hash_to_g2 as pure_h2g2
+    from .pure.hash_to_curve import hash_to_g2 as pure_h2g2
 
-        hpt = pure_h2g2(msg, ETH2_DST)
-        sig_pts.append(pc.multiply(hpt, total))
-        pk_pts.append([ps.sk_to_pubkey_point(sk) for sk in sks])
-        h_pts.append(hpt)
+    n_total = n_committees * committee_size
+    all_sks = [
+        [ps.deterministic_secret_key(c * committee_size + i)
+         for i in range(committee_size)]
+        for c in range(n_committees)]
+    msgs = [hashlib.sha256(b"attestation-root-%d" % c).digest()
+            for c in range(n_committees)]
+    h_pts = [pure_h2g2(m, ETH2_DST) for m in msgs]
+    # aggregate signature per committee: sigma = [sum sk_i] H(m)
+    totals = [sum(sks) % R for sks in all_sks]
 
-    flat_pks = [p for row in pk_pts for p in row]
-    pk_jac = pack_g1_points(flat_pks)
-    pk_jac = tuple(
-        t.reshape((n_committees, committee_size) + t.shape[1:])
-        for t in pk_jac)
-    sig_jac = pack_g2_points(sig_pts)
-    # H(m) was already derived by the pure model above; packing it
-    # directly (affine, Z=1) avoids compiling the device h2c graphs in
-    # processes that only need a slot batch (the multichip dryrun).
-    h_jac = pack_g2_points(h_pts)
+    if n_total >= 256:
+        # DEVICE key derivation (VERDICT r4 cold-start): one batched
+        # 255-bit double-and-add scan derives every pubkey — the pure
+        # path costs ~240 ms/key on this host class (~50 min for the
+        # 12.8k-key production shape, the round-4 bench timeout).
+        # Same for the per-committee aggregate signatures.
+        from .xla.curve import (
+            g1_generator, scalar_bits_from_ints, scalar_mul,
+        )
+        from .xla.curve import FP_OPS, FQ2_OPS
+
+        flat_sks = [sk for sks in all_sks for sk in sks]
+        gen = g1_generator(batch=n_total)
+        pk_jac = scalar_mul(FP_OPS, gen,
+                            scalar_bits_from_ints(flat_sks, 256))
+        pk_jac = tuple(
+            t.reshape((n_committees, committee_size) + t.shape[1:])
+            for t in pk_jac)
+        h_jac = pack_g2_points(h_pts)
+        sig_jac = scalar_mul(FQ2_OPS, h_jac,
+                             scalar_bits_from_ints(totals, 256))
+        sig_jac = tuple(jnp.asarray(t) for t in sig_jac)
+    else:
+        # tiny shapes (tests, the multichip dryrun): the pure path is
+        # seconds and keeps those processes' compile surface minimal
+        sig_pts = [pc.multiply(h, t) for h, t in zip(h_pts, totals)]
+        pk_pts = [[ps.sk_to_pubkey_point(sk) for sk in sks]
+                  for sks in all_sks]
+        flat_pks = [p for row in pk_pts for p in row]
+        pk_jac = pack_g1_points(flat_pks)
+        pk_jac = tuple(
+            t.reshape((n_committees, committee_size) + t.shape[1:])
+            for t in pk_jac)
+        sig_jac = pack_g2_points(sig_pts)
+        # H(m) from the pure model, packed directly (affine, Z=1)
+        h_jac = pack_g2_points(h_pts)
     r_bits = random_rlc_bits(n_committees, np.random.default_rng(7),
                              nbits=rlc_bits)
     try:

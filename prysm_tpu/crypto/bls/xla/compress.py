@@ -1,0 +1,262 @@
+"""Batched point decompression + subgroup checks on device.
+
+Reference analog: blst's ``blst_p1_uncompress`` / ``blst_p2_uncompress``
+and the in-group checks the reference performs on every deserialized
+key/signature (crypto/bls L0 [U, SURVEY.md §2 rows 1-3]).
+
+The host pure path (``pure/signature.g1_from_bytes``) costs ~100 ms
+PER KEY on this class of host — the subgroup check is a full
+scalar-mul by the group order in pure Python — which made any cold
+registry walk (12,800 keys/slot, 500k/registry) host-bound.  Here the
+whole registry decompresses in ONE device dispatch:
+
+* byte parsing / flag extraction is vectorized numpy (no crypto);
+* y = sqrt(x^3 + b) batches the Fp/Fq2 exponentiation as one
+  ``lax.scan`` over the fixed exponent bits, shared by every point;
+* sign selection compares canonical y against (P-1)/2
+  lexicographically (log-depth prefix, no host roundtrip);
+* the r-order subgroup check is one batched double-and-add scan by
+  the static group order.
+
+Failure is fail-closed: every check folds into a per-point ``ok``
+mask; callers map !ok to the infinity point, which can only REMOVE a
+signer's key from an aggregate — a verification that would have
+passed with the true key then fails.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..params import B_G1, B_G2_C0, B_G2_C1, P, R
+from . import limbs as L
+from . import tower as T
+from .curve import FP_OPS, FQ2_OPS, point_is_inf, scalar_mul_static
+
+C_FLAG = 0x80
+I_FLAG = 0x40
+S_FLAG = 0x20
+
+_HALF_LIMBS = L.int_to_limbs_np((P - 1) // 2)
+_B1_MONT = L.int_to_limbs_np(B_G1 * L.R_MOD_P % P)
+_B2_C0_MONT = L.int_to_limbs_np(B_G2_C0 * L.R_MOD_P % P)
+_B2_C1_MONT = L.int_to_limbs_np(B_G2_C1 * L.R_MOD_P % P)
+_P_LIMBS = L.P_LIMBS
+
+
+# --- host-side byte parsing (vectorized numpy, no field math) --------------
+
+
+def _bytes_to_limbs(be48: np.ndarray) -> np.ndarray:
+    """(n, 48) big-endian bytes -> (n, 24) little-endian 16-bit limbs."""
+    le = be48[:, ::-1].astype(np.uint32)
+    return le[:, 0::2] | (le[:, 1::2] << 8)
+
+
+def parse_g1_compressed(data: np.ndarray):
+    """(n, 48) uint8 -> (x_limbs (n,24), inf (n,), sign (n,), wf (n,)).
+
+    ``wf`` (well-formed) covers the flag/range rules that need no
+    field math: compression flag set, infinity encoded canonically,
+    x < P.  Everything else (on-curve, subgroup) is device work."""
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    assert data.ndim == 2 and data.shape[1] == 48, data.shape
+    flags = data[:, 0]
+    comp = (flags & C_FLAG) != 0
+    inf = (flags & I_FLAG) != 0
+    sign = (flags & S_FLAG) != 0
+    unflagged = data.copy()
+    unflagged[:, 0] &= 0x1F
+    x = _bytes_to_limbs(unflagged)
+    x_lt_p = _np_lex_lt(x, _P_LIMBS)
+    rest_zero = ~unflagged.any(axis=1)
+    wf = comp & np.where(
+        inf, rest_zero & ~sign,          # canonical infinity encoding
+        x_lt_p)
+    return x, inf, sign, wf
+
+
+def parse_g2_compressed(data: np.ndarray):
+    """(n, 96) uint8 -> (x_limbs (n,2,24) [c0,c1], inf, sign, wf)."""
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    assert data.ndim == 2 and data.shape[1] == 96, data.shape
+    flags = data[:, 0]
+    comp = (flags & C_FLAG) != 0
+    inf = (flags & I_FLAG) != 0
+    sign = (flags & S_FLAG) != 0
+    hi = data[:, :48].copy()             # x.c1 carries the flags
+    hi[:, 0] &= 0x1F
+    lo = data[:, 48:]
+    c1 = _bytes_to_limbs(hi)
+    c0 = _bytes_to_limbs(lo)
+    x = np.stack([c0, c1], axis=1)
+    in_field = _np_lex_lt(c0, _P_LIMBS) & _np_lex_lt(c1, _P_LIMBS)
+    rest_zero = ~(hi.any(axis=1) | lo.any(axis=1))
+    wf = comp & np.where(inf, rest_zero & ~sign, in_field)
+    return x, inf, sign, wf
+
+
+def _np_lex_lt(a: np.ndarray, b_const: np.ndarray) -> np.ndarray:
+    """Host lexicographic a < b over little-endian limb rows."""
+    b = np.broadcast_to(b_const, a.shape)
+    lt = a < b
+    eq = a == b
+    out = np.zeros(a.shape[:-1], dtype=bool)
+    done = np.zeros(a.shape[:-1], dtype=bool)
+    for i in range(a.shape[-1] - 1, -1, -1):
+        out = np.where(~done & lt[..., i], True, out)
+        done |= ~eq[..., i]
+    return out
+
+
+# --- device helpers --------------------------------------------------------
+
+
+def _lex_gt_half(y_canon):
+    """canonical y > (P-1)/2, lexicographic over limbs (device)."""
+    half = jnp.asarray(_HALF_LIMBS)
+    gt = (y_canon > half)
+    eq = (y_canon == half)
+    # prefix-AND of equality from the most-significant limb down:
+    # flip so index 0 is the top limb, then cumulative product
+    eq_rev = jnp.flip(eq, axis=-1).astype(jnp.uint32)
+    gt_rev = jnp.flip(gt, axis=-1)
+    prefix = jnp.concatenate(
+        [jnp.ones_like(eq_rev[..., :1]),
+         jnp.cumprod(eq_rev[..., :-1], axis=-1)], axis=-1)
+    return jnp.any(gt_rev & (prefix == 1), axis=-1)
+
+
+def _fq2_lex_gt_half(y_canon):
+    """sign convention for Fq2 (matches pure _fq2_larger): compare c1
+    first; if c1 == 0, compare c0."""
+    c0, c1 = y_canon[..., 0, :], y_canon[..., 1, :]
+    c1_zero = jnp.all(c1 == 0, axis=-1)
+    return jnp.where(c1_zero, _lex_gt_half(c0), _lex_gt_half(c1))
+
+
+def _fp_sqrt(a_mont):
+    """sqrt in Fp (p % 4 == 3): cand = a^((P+1)/4); (cand, ok)."""
+    cand = L.fp_pow_fixed(a_mont, (P + 1) // 4)
+    ok = jnp.all(L.fp_sub(L.fp_sqr(cand), a_mont) == 0, axis=-1)
+    return cand, ok
+
+
+def _fq2_sqrt(a_mont):
+    """sqrt in Fq2 via the complex method (mirrors pure
+    ``Fq2.sqrt``): a1 = a^((P-3)/4); x0 = a1*a; alpha = a1*x0;
+    alpha == -1 ? i*x0 : ((alpha+1)^((P-1)/2))*x0.  Returns
+    (cand, ok) where ok <=> cand^2 == a."""
+    a1 = T.fq2_pow_fixed(a_mont, (P - 3) // 4)
+    x0 = T.fq2_mul(a1, a_mont)
+    alpha = T.fq2_mul(a1, x0)
+    # -1 in Montgomery Fq2: (P - R_MOD_P, 0)
+    neg_one_c0 = jnp.asarray(L.int_to_limbs_np(P - L.R_MOD_P))
+    is_neg_one = (
+        jnp.all(alpha[..., 0, :] == neg_one_c0, axis=-1)
+        & jnp.all(alpha[..., 1, :] == 0, axis=-1))
+    # i * x0 = (-x0.c1, x0.c0)
+    ix0 = jnp.stack(
+        [L.fp_neg(x0[..., 1, :]), x0[..., 0, :]], axis=-2)
+    one = T.fq2_one_like(alpha)
+    b = T.fq2_pow_fixed(T.fq2_add(alpha, one), (P - 1) // 2)
+    bx0 = T.fq2_mul(b, x0)
+    cand = T.fq2_select(is_neg_one, ix0, bx0)
+    diff = T.fq2_sub(T.fq2_sqr(cand), a_mont)
+    ok = jnp.all(diff == 0, axis=(-1, -2))
+    return cand, ok
+
+
+def _jac_with_inf(ops, x, y, inf):
+    """Affine (x, y) + inf mask -> Jacobian triple ((1,1,0) at inf)."""
+    if ops.ndims == 2:
+        # Fq2 one: (ONE_MONT, 0)
+        one = jnp.stack(
+            [jnp.broadcast_to(jnp.asarray(L.ONE_MONT),
+                              x[..., 0, :].shape),
+             jnp.zeros_like(x[..., 0, :])], axis=-2)
+    else:
+        one = jnp.broadcast_to(jnp.asarray(L.ONE_MONT), x.shape)
+    z = ops.select(~inf, one, jnp.zeros_like(one))
+    xx = ops.select(~inf, x, one)
+    yy = ops.select(~inf, y, one)
+    return (xx, yy, z)
+
+
+# --- device decompression --------------------------------------------------
+
+
+@jax.jit
+def g1_decompress_device(x_limbs, inf, sign, wf):
+    """Batched G1 decompression + r-order subgroup check.
+
+    Inputs from ``parse_g1_compressed`` (x_limbs uint32 (n, 24), the
+    rest bool (n,)).  Returns (jac, ok): Jacobian Montgomery triple
+    (n, 24) x3 and the validity mask.  !ok points come out as
+    infinity (fail-closed: aggregates lose the key, verification
+    fails)."""
+    xm = L.to_mont(x_limbs)
+    rhs = L.fp_add(L.fp_mul(L.fp_sqr(xm), xm),
+                   jnp.broadcast_to(jnp.asarray(_B1_MONT), xm.shape))
+    y, on_curve = _fp_sqrt(rhs)
+    y_big = _lex_gt_half(L.from_mont(y))
+    y = L.fp_select(y_big == sign, y, L.fp_neg(y))
+    jac = _jac_with_inf(FP_OPS, xm, y, inf)
+    rp = scalar_mul_static(FP_OPS, jac, R)
+    in_group = point_is_inf(FP_OPS, rp)
+    ok = wf & ((inf & ~sign) | (~inf & on_curve & in_group))
+    jac = tuple(FP_OPS.select(ok, t, i)
+                for t, i in zip(jac, _jac_with_inf(
+                    FP_OPS, xm, y, jnp.ones_like(inf))))
+    return jac, ok
+
+
+@jax.jit
+def g2_decompress_device(x_limbs, inf, sign, wf):
+    """Batched G2 decompression + subgroup check.  x_limbs uint32
+    (n, 2, 24) [c0, c1]; returns ((X, Y, Z) Fq2 Jacobian, ok)."""
+    xm = L.to_mont(x_limbs)
+    b2 = jnp.stack(
+        [jnp.broadcast_to(jnp.asarray(_B2_C0_MONT), xm[..., 0, :].shape),
+         jnp.broadcast_to(jnp.asarray(_B2_C1_MONT), xm[..., 1, :].shape)],
+        axis=-2)
+    rhs = T.fq2_add(T.fq2_mul(T.fq2_sqr(xm), xm), b2)
+    y, on_curve = _fq2_sqrt(rhs)
+    y_big = _fq2_lex_gt_half(L.from_mont(y))
+    y = T.fq2_select(y_big == sign, y, T.fq2_neg(y))
+    jac = _jac_with_inf(FQ2_OPS, xm, y, inf)
+    rp = scalar_mul_static(FQ2_OPS, jac, R)
+    in_group = point_is_inf(FQ2_OPS, rp)
+    ok = wf & ((inf & ~sign) | (~inf & on_curve & in_group))
+    jac = tuple(FQ2_OPS.select(ok, t, i)
+                for t, i in zip(jac, _jac_with_inf(
+                    FQ2_OPS, xm, y, jnp.ones_like(inf))))
+    return jac, ok
+
+
+# --- convenience wrappers --------------------------------------------------
+
+
+def g1_decompress_batch(pubkeys: list[bytes]):
+    """list of 48-byte compressed pubkeys -> (jac, ok ndarray)."""
+    data = np.frombuffer(b"".join(pubkeys), dtype=np.uint8)
+    data = data.reshape(len(pubkeys), 48)
+    x, inf, sign, wf = parse_g1_compressed(data)
+    jac, ok = g1_decompress_device(
+        jnp.asarray(x), jnp.asarray(inf), jnp.asarray(sign),
+        jnp.asarray(wf))
+    return jac, np.asarray(ok)
+
+
+def g2_decompress_batch(sigs: list[bytes]):
+    """list of 96-byte compressed signatures -> (jac, ok ndarray)."""
+    data = np.frombuffer(b"".join(sigs), dtype=np.uint8)
+    data = data.reshape(len(sigs), 96)
+    x, inf, sign, wf = parse_g2_compressed(data)
+    jac, ok = g2_decompress_device(
+        jnp.asarray(x), jnp.asarray(inf), jnp.asarray(sign),
+        jnp.asarray(wf))
+    return jac, np.asarray(ok)

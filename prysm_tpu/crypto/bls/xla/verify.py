@@ -171,6 +171,53 @@ def slot_verify_device(pk_jac, sig_jac, h_jac, r_bits):
     return _pairing_check(p_x, p_y, qx, qy, mask)
 
 
+@jax.jit
+def indexed_slot_verify_device(pk_x, pk_y, pk_inf, idx, idx_mask,
+                               sig_jac, h_jac, r_bits, att_mask):
+    """The pool -> verdict slot dispatch with ZERO host point math:
+    per-attestation signer sets arrive as INDEX ROWS into the
+    registry-wide packed pubkey table, and the aggregate public keys
+    are computed on device (gather + masked Jacobian sum tree) inside
+    the same graph as the RLC pairing check.
+
+    pk_x/pk_y: (N, 24) Montgomery affine registry table;
+    pk_inf: (N,) bool (invalid/infinity table entries — their lanes
+    aggregate as identity, so a signer with a bad key FAILS its
+    attestation rather than being skipped);
+    idx: (A, K) int32 signer indices; idx_mask: (A, K) bool;
+    sig_jac: (A,) G2 Jacobian signatures; h_jac: (A,) G2 message
+    hashes; r_bits: uint32 (nbits, A); att_mask: (A,) bool."""
+    gx = jnp.take(pk_x, idx, axis=0)             # (A, K, 24)
+    gy = jnp.take(pk_y, idx, axis=0)
+    dead = jnp.take(pk_inf, idx, axis=0) | ~idx_mask
+    one = jnp.broadcast_to(jnp.asarray(L.ONE_MONT), gx.shape)
+    z = L.fp_select(~dead, one, jnp.zeros_like(one))
+    pk_t = tuple(jnp.moveaxis(t, 1, 0)
+                 for t in (gx, gy, z))           # (K, A, 24)
+    apk = point_sum_tree(FP_OPS, pk_t)           # (A,)
+    r_apk = scalar_mul_windowed_glv(FP_OPS, apk, r_bits)
+    r_sig = scalar_mul_windowed_glv(FQ2_OPS, sig_jac, r_bits)
+    r_sig = point_select(FQ2_OPS, att_mask, r_sig,
+                         point_inf_like(FQ2_OPS, r_sig))
+    s = point_sum_tree(FQ2_OPS, r_sig)
+    g2_all = tuple(jnp.concatenate([t_s[None], t_h], axis=0)
+                   for t_s, t_h in zip(s, h_jac))
+    (ax, ay, a_inf), (qx, qy, q_inf) = _batch_affine(r_apk, g2_all)
+    s_inf = q_inf[:1]
+    ng_x, ng_y = _neg_g1_affine()
+    p_x = jnp.concatenate([ng_x[None], ax], axis=0)
+    p_y = jnp.concatenate([ng_y[None], ay], axis=0)
+    mask = jnp.concatenate([~s_inf, att_mask & ~a_inf], axis=0)
+    ok = _pairing_check(p_x, p_y, qx, qy, mask)
+    # FAIL-CLOSED: a LIVE attestation whose aggregate pubkey is
+    # infinity (dead table rows, or pubkeys summing to the identity)
+    # must fail the batch, not drop out of the product — otherwise an
+    # infinity-encoded signature would pair trivially with the masked
+    # lane and verify a never-checked attestation
+    bad_apk = jnp.any(att_mask & a_inf)
+    return ok & ~bad_apk
+
+
 _SHARDED_CACHE: dict = {}
 
 

@@ -4,8 +4,12 @@ Reference analog: ``monitoring/prometheus`` + ``monitoring/tracing``
 (opencensus) [U, SURVEY.md §2 "monitoring", §5].
 """
 
-from .metrics import Counter, Gauge, Histogram, MetricsRegistry, metrics
+from .metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry, metrics,
+    prometheus_registry, serve_prometheus,
+)
 from .tracing import span, enable_jax_trace
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "metrics", "span", "enable_jax_trace"]
+           "metrics", "prometheus_registry", "serve_prometheus",
+           "span", "enable_jax_trace"]

@@ -147,3 +147,75 @@ class MetricsRegistry:
 # process-global default registry (reference uses the prometheus
 # default registerer the same way)
 metrics = MetricsRegistry()
+
+
+# --- prometheus_client bridge ----------------------------------------------
+#
+# The reference exposes its metrics through the standard prometheus
+# client library; this bridge registers OUR registry as a custom
+# collector so the ecosystem tooling (prometheus_client's HTTP
+# exposition, pushgateways, scrapers asserting on the standard
+# content type) sees the same metric families the text renderer
+# prints.  The in-tree renderer stays — it has zero dependencies and
+# serves the BeaconHTTPServer /metrics route.
+
+
+class _RegistryCollector:
+    """prometheus_client custom collector over a MetricsRegistry."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self._registry = registry
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily, GaugeMetricFamily,
+            HistogramMetricFamily,
+        )
+
+        with self._registry._lock:
+            items = sorted(self._registry._metrics.items())
+        for name, m in items:
+            if isinstance(m, Counter):
+                yield CounterMetricFamily(name, m.help or name,
+                                          value=m.value)
+            elif isinstance(m, Gauge):
+                yield GaugeMetricFamily(name, m.help or name,
+                                        value=m.value)
+            elif isinstance(m, Histogram):
+                # snapshot counts/total/n TOGETHER under the
+                # histogram's own lock: a scrape racing observe()
+                # could otherwise emit cum(buckets) > the +Inf count,
+                # breaking the Prometheus monotonicity invariant
+                with m._lock:
+                    counts = list(m.counts)
+                    total, n = m.total, m.n
+                cum, buckets = 0, []
+                for b, c in zip(m.buckets, counts):
+                    cum += c
+                    buckets.append((str(b), cum))
+                buckets.append(("+Inf", n))
+                yield HistogramMetricFamily(name, m.help or name,
+                                            buckets=buckets,
+                                            sum_value=total)
+
+
+def prometheus_registry(registry: MetricsRegistry | None = None):
+    """A dedicated prometheus_client CollectorRegistry exposing
+    ``registry`` (default: the process-global one).  Feed it to
+    ``prometheus_client.start_http_server(port, registry=...)`` or
+    ``generate_latest(...)``."""
+    from prometheus_client import CollectorRegistry
+
+    reg = CollectorRegistry()
+    reg.register(_RegistryCollector(registry or metrics))
+    return reg
+
+
+def serve_prometheus(port: int, registry: MetricsRegistry | None = None,
+                     addr: str = "127.0.0.1"):
+    """Serve the bridge on prometheus_client's standard HTTP exposition
+    server; returns (httpd, thread) for shutdown."""
+    from prometheus_client import start_http_server
+
+    return start_http_server(port, addr=addr,
+                             registry=prometheus_registry(registry))

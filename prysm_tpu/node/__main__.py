@@ -41,6 +41,24 @@ def main(argv=None) -> int:
     p.add_argument("--enable-tracing", action="store_true")
     p.add_argument("--metrics", action="store_true",
                    help="print the /metrics exposition at the end")
+    p.add_argument("--prometheus-port", type=int, default=None,
+                   help="serve node-0 metrics via prometheus_client's "
+                        "standard HTTP exposition on this port")
+    p.add_argument("--listen", type=int, default=None, metavar="PORT",
+                   help="accept inbound TCP gossip links on this port "
+                        "(0 = ephemeral; the bound port is printed)")
+    p.add_argument("--peer", action="append", default=[],
+                   metavar="HOST:PORT",
+                   help="dial an outbound TCP gossip link (repeatable)")
+    p.add_argument("--bootnode", default=None, metavar="HOST:PORT",
+                   help="register with a discovery bootnode and dial "
+                        "every discovered peer (requires --listen)")
+    p.add_argument("--node-key", type=int, default=0,
+                   help="deterministic identity key index for the "
+                        "signed discovery record")
+    p.add_argument("--genesis-time", type=int, default=None,
+                   help="explicit genesis unix time (multi-process "
+                        "deployments must share one; default: now)")
     p.add_argument("--rpc-port", type=int, default=None,
                    help="serve the v1alpha1 validator RPC for node 0 "
                         "on this port")
@@ -87,7 +105,9 @@ def main(argv=None) -> int:
 
     types = build_types(beacon_config())
     genesis = deterministic_genesis_state(args.validators, types)
-    genesis.genesis_time = int(time.time())
+    genesis.genesis_time = (args.genesis_time
+                            if args.genesis_time is not None
+                            else int(time.time()))
 
     bus = GossipBus()
     nodes = [BeaconNode(bus, f"node-{i}", genesis, types=types)
@@ -97,9 +117,97 @@ def main(argv=None) -> int:
     print(f"started {args.nodes} nodes, {args.validators} validators, "
           f"bls={args.bls_implementation}")
 
+    if args.prometheus_port is not None:
+        from ..monitoring import serve_prometheus
+
+        serve_prometheus(args.prometheus_port, nodes[0].metrics)
+        print(f"prometheus exposition on :{args.prometheus_port}",
+              flush=True)
+
+    # --- cross-process networking (TCP gossip + discovery) -----------------
+    listener = None
+    out_bridges = []
+    relay_topics = [TOPIC_BLOCK]
+    from ..p2p import TOPIC_AGGREGATE, TOPIC_ATTESTATION
+
+    relay_topics += [TOPIC_ATTESTATION, TOPIC_AGGREGATE]
+    if args.listen is not None:
+        from ..p2p import BridgeListener
+
+        listener = BridgeListener(bus, relay_topics, port=args.listen)
+        print(f"gossip listen on {listener.host}:{listener.port}",
+              flush=True)
+    for spec in args.peer:
+        from ..p2p import TCPBridge
+
+        host, port_s = spec.rsplit(":", 1)
+        br = TCPBridge(bus, f"dial-{spec}", relay_topics)
+        for attempt in range(5):
+            # a co-started peer may still be bringing its listener up
+            try:
+                br.connect(host, int(port_s))
+                break
+            except OSError:
+                if attempt == 4:
+                    raise
+                time.sleep(2.0)
+        out_bridges.append(br)
+        print(f"gossip dial {spec}: connected", flush=True)
+    if args.bootnode is not None:
+        if listener is None:
+            p.error("--bootnode requires --listen")
+        from ..crypto.bls import bls as _bls
+        from ..p2p import TCPBridge
+        from ..p2p.discovery import NodeRecord, lookup, register
+
+        bhost, bport_s = args.bootnode.rsplit(":", 1)
+        sk, _pk = _bls.deterministic_keypair(10_000 + args.node_key)
+        record = NodeRecord.create(sk, listener.host, listener.port,
+                                   seq=1)
+        for attempt in range(3):
+            try:
+                register(bhost, int(bport_s), record)
+                break
+            except (OSError, TimeoutError):
+                if attempt == 2:
+                    raise
+                time.sleep(2.0)
+        for rec in lookup(bhost, int(bport_s)):
+            if (rec.host, rec.port) == (listener.host, listener.port):
+                continue                    # our own record
+            br = TCPBridge(bus, f"disc-{rec.node_id[:8]}",
+                           relay_topics)
+            for attempt in range(5):
+                # a freshly-registered peer may still be bringing its
+                # listener up; transient refusal is not fatal
+                try:
+                    br.connect(rec.host, rec.port)
+                    break
+                except OSError:
+                    if attempt == 4:
+                        print(f"gossip dial {rec.host}:{rec.port}: "
+                              "unreachable, skipping", flush=True)
+                        br.close()
+                        br = None
+                        break
+                    time.sleep(2.0)
+            if br is None:
+                continue
+            out_bridges.append(br)
+            print(f"gossip dial (discovered) {rec.host}:{rec.port}",
+                  flush=True)
+
     rpc_server = None
     if args.rpc_port is not None:
-        if args.rpc_carrier == "grpc":
+        carrier = args.rpc_carrier
+        if carrier == "grpc":
+            from ..rpc import GrpcValidatorServer
+
+            if GrpcValidatorServer is None:
+                print("warning: grpcio not installed; falling back to "
+                      "--rpc-carrier framed", flush=True)
+                carrier = args.rpc_carrier = "framed"
+        if carrier == "grpc":
             from ..rpc import GrpcValidatorServer, ValidatorAPI
 
             rpc_server = GrpcValidatorServer(ValidatorAPI(nodes[0]),
@@ -131,7 +239,7 @@ def main(argv=None) -> int:
             if reached_at is None:
                 if nodes[0].head_slot() >= args.slots:
                     reached_at = time.time()
-            elif time.time() - reached_at >= spslot:
+            elif time.time() - reached_at >= 2 * spslot:
                 break
             time.sleep(0.25)
         heads = {n.node_id: n.head_slot() for n in nodes}

@@ -81,6 +81,17 @@ class BeaconNode:
 
             self.shards = ShardService(genesis_root)
 
+        # DB-backed slasher (slasherkv analog) observing every
+        # verified attestation; detections land in the slashing pool
+        # and from there in proposed blocks
+        self.slasher = None
+        if features().slasher:
+            from ..slasher import SlasherService
+
+            self.slasher = SlasherService(self)
+            self.sync.att_observers.append(
+                self.slasher.on_verified_attestation)
+
         # registration order IS dependency order
         self.registry.register("db", _NullService(self.db))
         self.registry.register("stategen", _NullService(self.stategen))
@@ -88,6 +99,8 @@ class BeaconNode:
         self.registry.register("sync", self.sync)
         if self.shards is not None:
             self.registry.register("shard", self.shards)
+        if self.slasher is not None:
+            self.registry.register("slasher", self.slasher)
         self.registry.register("ticker", self.ticker)
 
     # --- lifecycle ---------------------------------------------------------

@@ -10,8 +10,8 @@ multi-node tests and the node harness.
 
 from .bus import GossipBus, Peer, TOPIC_BLOCK, TOPIC_ATTESTATION, \
     TOPIC_AGGREGATE, TOPIC_EXIT, TOPIC_SLASHING
-from .transport import TCPBridge
+from .transport import BridgeListener, TCPBridge
 
-__all__ = ["GossipBus", "Peer", "TCPBridge", "TOPIC_BLOCK",
-           "TOPIC_ATTESTATION", "TOPIC_AGGREGATE", "TOPIC_EXIT",
-           "TOPIC_SLASHING"]
+__all__ = ["GossipBus", "Peer", "TCPBridge", "BridgeListener",
+           "TOPIC_BLOCK", "TOPIC_ATTESTATION", "TOPIC_AGGREGATE",
+           "TOPIC_EXIT", "TOPIC_SLASHING"]

@@ -218,7 +218,7 @@ class Bootnode:
 
 
 def register(host: str, port: int, record: NodeRecord,
-             timeout: float = 5.0) -> None:
+             timeout: float = 30.0) -> None:
     with socket.create_connection((host, port), timeout=timeout) as s:
         _send_line(s, "REG " + record.encode())
         with s.makefile("rb") as f:
@@ -228,7 +228,7 @@ def register(host: str, port: int, record: NodeRecord,
 
 
 def lookup(host: str, port: int,
-           timeout: float = 5.0) -> list[NodeRecord]:
+           timeout: float = 30.0) -> list[NodeRecord]:
     """Fetch + verify the directory's records (forged entries raise
     in decode, so a poisoned directory cannot go unnoticed)."""
     out = []

@@ -152,7 +152,12 @@ class TCPBridge:
 
     def _local_handler(self, topic: str):
         def handler(from_peer: str, data: bytes) -> Verdict:
-            # locally published message: relay to the remote process
+            # locally published message: relay to the remote process.
+            # Mark it seen (gossipsub message-id dedup analog) so a
+            # copy coming BACK around a multi-bridge cycle is dropped
+            # at the receive side; every sibling bridge still forwards
+            # (the mesh flood), since only _read_loop CHECKS the mark.
+            _relay_mark(self.bus, topic, data)
             try:
                 self._send_frame(self.KIND_GOSSIP, topic, 0, data)
             except (ConnectionError, OSError):
@@ -199,6 +204,11 @@ class TCPBridge:
                     raise ConnectionError("truncated frame")
                 payload = snappy.decompress(comp, max_out=_MAX_FRAME)
                 if kind == self.KIND_GOSSIP:
+                    # duplicate (it cycled back, or two peers relayed
+                    # the same message): drop — rebroadcasting would
+                    # loop forever in cyclic topologies
+                    if not _relay_mark(self.bus, name, payload):
+                        continue
                     # into the local bus AS the bridge peer: the bus
                     # excludes the sender, so it won't echo back
                     self.bus.broadcast(self.peer.peer_id, name, payload)
@@ -258,3 +268,86 @@ def _varint_bytes(n: int) -> bytes:
         out.append(b | 0x80 if n else b)
         if not n:
             return bytes(out)
+
+
+# --- relay dedup -----------------------------------------------------------
+#
+# gossipsub message-id cache analog, per bus: bounded FIFO of
+# sha256(topic || data) ids.  Forwarders MARK (so returning copies are
+# recognizable); receivers MARK-AND-CHECK (drop duplicates).
+
+_RELAY_CACHE_MAX = 8192
+_RELAY_INIT_LOCK = threading.Lock()
+
+
+def _relay_mark(bus: GossipBus, topic: str, data: bytes) -> bool:
+    """Record (topic, data) in the bus's relay cache; True if new.
+
+    Thread-safe: per-bridge reader threads and publisher threads all
+    call this concurrently — init and the check-then-add must be
+    atomic or two readers of the same message both rebroadcast."""
+    import hashlib
+    from collections import deque
+
+    cache = getattr(bus, "_relay_cache", None)
+    if cache is None:
+        with _RELAY_INIT_LOCK:
+            cache = getattr(bus, "_relay_cache", None)
+            if cache is None:
+                cache = (set(), deque(), threading.Lock())
+                bus._relay_cache = cache
+    seen, order, lock = cache
+    mid = hashlib.sha256(topic.encode() + b"\x00" + data).digest()[:16]
+    with lock:
+        if mid in seen:
+            return False
+        seen.add(mid)
+        order.append(mid)
+        if len(order) > _RELAY_CACHE_MAX:
+            seen.discard(order.popleft())
+    return True
+
+
+class BridgeListener:
+    """Accept-loop that grows one ``TCPBridge`` per inbound link — the
+    listening side of an N-process mesh (the reference's libp2p host
+    accepts any number of dials; ``TCPBridge.listen`` takes exactly
+    one)."""
+
+    def __init__(self, bus: GossipBus, relay_topics: list[str],
+                 host: str = "127.0.0.1", port: int = 0,
+                 peer_prefix: str = "in"):
+        self.bus = bus
+        self.relay_topics = list(relay_topics)
+        self.bridges: list[TCPBridge] = []
+        self._srv = socket.create_server((host, port))
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._prefix = peer_prefix
+        self._closed = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True,
+                                        name=f"bridge-listen-{self.port}")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        n = 0
+        while not self._closed.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return                       # closed
+            n += 1
+            bridge = TCPBridge(self.bus,
+                               f"{self._prefix}-{self.port}-{n}",
+                               self.relay_topics)
+            bridge._attach(conn)
+            self.bridges.append(bridge)
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for b in self.bridges:
+            b.close()

@@ -4,6 +4,6 @@ Reference analog: ``beacon-chain/slasher`` + ``db/slasherkv`` [U,
 SURVEY.md §2 "slasherkv + slasher"].
 """
 
-from .service import Slasher
+from .service import Slasher, SlasherKV, SlasherService
 
-__all__ = ["Slasher"]
+__all__ = ["Slasher", "SlasherKV", "SlasherService"]

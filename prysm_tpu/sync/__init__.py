@@ -4,7 +4,8 @@ Reference analog: ``beacon-chain/sync`` (+ ``initial-sync``) [U,
 SURVEY.md §2 "sync svc", §3.3, §3.5].
 """
 
-from .service import SyncService
-from .initial import initial_sync
+from .service import RPC_BLOCKS_BY_RANGE, SyncService
+from .initial import SyncPeerScorer, initial_sync
 
-__all__ = ["SyncService", "initial_sync"]
+__all__ = ["RPC_BLOCKS_BY_RANGE", "SyncService", "SyncPeerScorer",
+           "initial_sync"]

@@ -1,13 +1,18 @@
-"""Initial sync: round-robin batch catch-up replay.
+"""Initial sync: multi-peer round-robin batch catch-up with scoring.
 
-Reference analog: ``beacon-chain/sync/initial-sync`` [U, SURVEY.md §2,
-§3.5]: fetch BeaconBlocksByRange in batches from peers (round-robin),
-then apply each batch through the state transition with signature
-verification batched across the whole batch of blocks — the biggest
-SignatureBatch user in the reference, and BASELINE config #5's loop.
+Reference analog: ``beacon-chain/sync/initial-sync`` +
+``p2p/peers/scorers`` [U, SURVEY.md §2, §3.5]: fetch
+BeaconBlocksByRange in batches from peers (best-scored first with
+round-robin rotation), penalize peers that stall or serve bad batches,
+fail over to the next peer for the same window, and apply each batch
+through the state transition with signature verification batched
+across the whole span — the biggest SignatureBatch user in the
+reference, and BASELINE config #5's loop.
 """
 
 from __future__ import annotations
+
+from collections import defaultdict
 
 from ..blockchain import BlockchainService, BlockProcessingError
 from ..core.transition import (
@@ -15,6 +20,47 @@ from ..core.transition import (
     state_transition,
 )
 from .service import RPC_BLOCKS_BY_RANGE
+
+# score deltas (reference scorers use exponential decay; a fixed
+# ladder keeps the policy auditable: ~BAD_THRESHOLD/PENALTY_* strikes
+# before a peer is benched)
+REWARD_GOOD_BATCH = 0.25
+PENALTY_BAD_BATCH = 1.0        # well-formed but wrong (sig/transition)
+PENALTY_MALFORMED = 1.0        # undecodable bytes
+PENALTY_STALL = 2.0            # timeout: worst — it burns wall-clock
+BAD_THRESHOLD = -3.0
+
+
+class SyncPeerScorer:
+    """Per-peer fetch scoring (``peers/scorers`` analog).  Peers at or
+    below ``BAD_THRESHOLD`` are benched: never selected while any
+    non-bad peer remains, retried only as a last resort."""
+
+    def __init__(self):
+        self.scores: dict[str, float] = defaultdict(float)
+
+    def reward(self, peer_id: str, amount: float = REWARD_GOOD_BATCH):
+        self.scores[peer_id] += amount
+
+    def penalize(self, peer_id: str, amount: float):
+        self.scores[peer_id] -= amount
+
+    def is_bad(self, peer_id: str) -> bool:
+        return self.scores[peer_id] <= BAD_THRESHOLD
+
+    def ordered(self, peer_ids, rotation: int = 0) -> list[str]:
+        """Peers for one window's attempts: non-bad peers first
+        (round-robin rotated so load spreads, stable-sorted so better
+        peers still lead on ties), then benched peers as a last
+        resort."""
+        ids = list(peer_ids)
+        if not ids:
+            return []
+        rot = ids[rotation % len(ids):] + ids[:rotation % len(ids)]
+        good = [p for p in rot if not self.is_bad(p)]
+        bad = [p for p in rot if self.is_bad(p)]
+        good.sort(key=lambda p: -self.scores[p])
+        return good + bad
 
 
 def _batch_signatures_valid(chain, blocks) -> bool:
@@ -37,48 +83,64 @@ def _batch_signatures_valid(chain, blocks) -> bool:
 
 
 def initial_sync(chain: BlockchainService, peer, target_slot: int,
-                 batch_size: int = 32, verify_signatures: bool = True
-                 ) -> int:
+                 batch_size: int = 32, verify_signatures: bool = True,
+                 scorer: SyncPeerScorer | None = None) -> int:
     """Catch ``chain`` up to ``target_slot`` by fetching ranges from
-    the bus peers round-robin.  Returns blocks applied.
+    the peers, best-scored-first with failover.  Returns blocks
+    applied.
 
-    The window cursor always advances (empty ranges are legal — slots
-    may be skipped), and a peer serving an invalid batch is skipped in
-    favor of the next peer for the same window.
+    Failure handling per window:
+    * request raising (timeout/transport/unknown-method) -> stall
+      penalty, next peer;
+    * undecodable SSZ -> malformed penalty, next peer;
+    * failed whole-span signature check or broken transition ->
+      bad-batch penalty, next peer;
+    * all peers failed -> the window is abandoned and sync returns
+      (the caller's retry loop re-enters with the scores retained, so
+      the next attempt leads with the peers that behaved).
+
+    The window cursor always advances on success even when a range is
+    empty — slots may legitimately be skipped.
     """
     sbt = chain.types.SignedBeaconBlock
+    scorer = scorer if scorer is not None else SyncPeerScorer()
     applied = 0
     others = peer.peers()
     if not others:
         return 0
-    rr = 0
+    rotation = 0
     window_start = chain.head_slot() + 1
     while window_start <= target_slot:
         count = min(batch_size, target_slot - window_start + 1)
         blocks = None
-        for _ in range(len(others)):
-            src = others[rr % len(others)]
-            rr += 1
+        for src in scorer.ordered(others, rotation):
             try:
                 raw = peer.request(src, RPC_BLOCKS_BY_RANGE, {
                     "start_slot": window_start, "count": count})
-            except KeyError:
+            except Exception:
+                # unreachable peer, no handler, or a stall/timeout
+                scorer.penalize(src, PENALTY_STALL)
                 continue
             try:
                 candidate = [sbt.deserialize(b) for b in raw]
             except Exception:
-                continue   # malformed bytes: skip this peer
+                scorer.penalize(src, PENALTY_MALFORMED)
+                continue
             if candidate and verify_signatures and \
                     not _batch_signatures_valid(chain, candidate):
-                continue   # bad batch: try next peer
+                scorer.penalize(src, PENALTY_BAD_BATCH)
+                continue
             blocks = candidate
+            scorer.reward(src)
             break
-        if blocks:
-            for blk in blocks:
-                try:
-                    chain.receive_block(blk, verify_signatures=False)
-                    applied += 1
-                except BlockProcessingError:
-                    return applied
+        else:
+            return applied          # every peer failed this window
+        rotation += 1
+        for blk in blocks:
+            try:
+                chain.receive_block(blk, verify_signatures=False)
+                applied += 1
+            except BlockProcessingError:
+                return applied
         window_start += count
     return applied

@@ -42,6 +42,10 @@ class SyncService:
         self._lock = threading.RLock()
         self.seen_block_roots: set[bytes] = set()
         self.seen_attestations: set[bytes] = set()
+        # callbacks fn(state, att) run on every signature-verified
+        # attestation (slasher feed — the reference streams these to
+        # its slasher binary over gRPC)
+        self.att_observers: list = []
 
     def start(self) -> None:
         from functools import partial
@@ -317,18 +321,31 @@ class SyncService:
         from ..core.helpers import get_indexed_attestation
 
         state = self.chain.head_state
-        batch = self.att_pool.build_slot_signature_batch(state, slot)
+        from ..config import features
+
+        if features().bls_implementation in ("xla", "pallas"):
+            # device-native path: signer INDEX rows + the registry
+            # pubkey table; aggregation happens on device inside the
+            # verify dispatch — no pure-Python point math per slot
+            batch = self.att_pool.build_slot_batch_indexed(state, slot)
+        else:
+            batch = self.att_pool.build_slot_signature_batch(state, slot)
         if len(batch) == 0:
             return True
         ok = batch.verify()
         if self.metrics is not None:
             self.metrics.inc("slot_batch_signatures", len(batch))
-        all_atts = [att
-                    for _, g in self.att_pool.groups_for_slot(slot).items()
-                    for att in g.aggregated + g.unaggregated]
+        # only the batch's OWN entries (captured under the pool lock
+        # at build time) are signature-verified by the verdict;
+        # re-scanning the pool here would be a TOCTOU hole — an
+        # attestation pooled after the build would reach votes and the
+        # slasher feed unverified
+        all_atts = batch.attestations
         if ok:
             for att in all_atts:
                 self.chain.process_attestation_votes(state, att)
+                for observer in self.att_observers:
+                    observer(state, att)
             return True
         if self.metrics is not None:
             self.metrics.inc("slot_batch_fallbacks")
@@ -341,6 +358,8 @@ class SyncService:
                 valid = False
             if valid:
                 self.chain.process_attestation_votes(state, att)
+                for observer in self.att_observers:
+                    observer(state, att)
             else:
                 any_bad = True
         return not any_bad

@@ -31,14 +31,49 @@ def secret_key_for(index: int) -> bls.SecretKey:
     return bls.SecretKey(deterministic_secret_key(index))
 
 
+_INTEROP_PK_CACHE: dict[int, list[bytes]] = {}
+
+
+def interop_pubkeys(n: int) -> list[bytes]:
+    """Compressed pubkeys for interop keys 0..n-1.
+
+    The pure derivation costs ~240 ms/key on this host class, which
+    made large-validator fixtures (16k+ registries for scale benches)
+    infeasible; for n >= 256 the whole set derives on device in ONE
+    batched double-and-add scan and is merely re-encoded here."""
+    cached = _INTEROP_PK_CACHE.get(n)
+    if cached is not None:
+        return list(cached)
+    from ..crypto.bls.pure.signature import (
+        deterministic_secret_key, g1_to_bytes,
+    )
+
+    if n < 256:
+        out = [secret_key_for(i).public_key().to_bytes()
+               for i in range(n)]
+    else:
+        from ..crypto.bls.xla.curve import (
+            FP_OPS, g1_generator, scalar_bits_from_ints, scalar_mul,
+            unpack_g1_points,
+        )
+
+        sks = [deterministic_secret_key(i) for i in range(n)]
+        jac = scalar_mul(FP_OPS, g1_generator(batch=n),
+                         scalar_bits_from_ints(sks, 256))
+        out = [g1_to_bytes(p) for p in unpack_g1_points(jac)]
+    _INTEROP_PK_CACHE[n] = out
+    return list(out)
+
+
 def deterministic_genesis_state(n_validators: int, types=None):
     """A valid genesis BeaconState with n active validators holding
     real (deterministic) BLS keys."""
     types = types or active_types()
     cfg = beacon_config()
+    pubkeys = interop_pubkeys(n_validators)
     validators, balances = [], []
     for i in range(n_validators):
-        pk = secret_key_for(i).public_key().to_bytes()
+        pk = pubkeys[i]
         wc = b"\x00" + hashlib.sha256(pk).digest()[1:]
         validators.append(Validator(
             pubkey=pk,
@@ -86,8 +121,17 @@ def sign_attestation_for_committee(state, data: AttestationData,
     domain = get_domain(state, cfg.domain_beacon_attester,
                         data.target.epoch)
     root = compute_signing_root(data, domain)
-    sigs = [secret_key_for(i).sign(root) for i in committee]
-    return bls.Signature.aggregate(sigs).to_bytes()
+    # aggregate-of-sigs == [sum sk_i] H(root): ONE scalar-mul instead
+    # of len(committee) signs + an aggregation walk (exactness: BLS
+    # aggregation is point addition, scalar-mul distributes over it)
+    from ..crypto.bls.params import ETH2_DST, R
+    from ..crypto.bls.pure import curve as pc
+    from ..crypto.bls.pure.hash_to_curve import hash_to_g2
+    from ..crypto.bls.pure.signature import deterministic_secret_key
+
+    total = sum(deterministic_secret_key(i) for i in committee) % R
+    point = pc.multiply(hash_to_g2(root, ETH2_DST), total)
+    return bls.Signature(point=point).to_bytes()
 
 
 def valid_attestation(state, slot: int, index: int,

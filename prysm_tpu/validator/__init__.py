@@ -7,6 +7,10 @@ slashing-protection DB) [U, SURVEY.md §2 "validator client", §3.4].
 from .keymanager import KeyManager
 from .protection import SlashingProtectionDB, ProtectionError
 from .client import ValidatorClient
+from .remote_signer import (
+    RemoteKeyManager, RemoteSignerError, RemoteSignerServer,
+)
 
 __all__ = ["KeyManager", "SlashingProtectionDB", "ProtectionError",
-           "ValidatorClient"]
+           "ValidatorClient", "RemoteKeyManager", "RemoteSignerError",
+           "RemoteSignerServer"]

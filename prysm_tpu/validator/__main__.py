@@ -37,6 +37,10 @@ def main(argv=None) -> int:
                    default="grpc",
                    help="RPC transport: real gRPC (default) or the "
                         "dependency-free framed-TCP fallback")
+    p.add_argument("--rpc-timeout", type=float, default=30.0,
+                   help="per-call RPC deadline in seconds (the duty "
+                        "loop shares the node's host, which may be "
+                        "busy verifying the previous slot)")
     p.add_argument("--protection-db", default=":memory:",
                    help="slashing-protection DB path (EIP-3076 "
                         "semantics; ':memory:' for the demo)")
@@ -57,14 +61,24 @@ def main(argv=None) -> int:
     from .protection import SlashingProtectionDB
 
     host, port_s = args.rpc.rsplit(":", 1)
-    if args.rpc_carrier == "grpc":
+    carrier = args.rpc_carrier
+    if carrier == "grpc":
         from ..rpc import GrpcValidatorClient
 
-        client = GrpcValidatorClient(host, int(port_s))
+        if GrpcValidatorClient is None:
+            print("warning: grpcio not installed; falling back to "
+                  "--rpc-carrier framed", flush=True)
+            carrier = "framed"
+    if carrier == "grpc":
+        from ..rpc import GrpcValidatorClient
+
+        client = GrpcValidatorClient(host, int(port_s),
+                                     timeout=args.rpc_timeout)
     else:
         from ..rpc import ValidatorRpcClient
 
-        client = ValidatorRpcClient(host, int(port_s))
+        client = ValidatorRpcClient(host, int(port_s),
+                                    timeout=args.rpc_timeout)
     health = client.node_health()
     genesis_time = health["genesis_time"]
     spslot = beacon_config().seconds_per_slot
@@ -76,15 +90,25 @@ def main(argv=None) -> int:
         client, km,
         protection=SlashingProtectionDB(args.protection_db))
 
-    done = 0
+    # wall-clock bound, not a processed-slot count: on a busy host the
+    # clock can skip slots, and a count-based loop would outlive the
+    # node's own (head-progress-based) serve window
     last = 0
-    while done < args.slots:
+    while last < args.slots:
         now = time.time()
         slot = max(0, int(now - genesis_time) // spslot)
         if slot > last:
             last = slot
-            vc.on_slot(slot)
-            done += 1
+            try:
+                vc.on_slot(slot)
+            except Exception as e:       # noqa: BLE001
+                # reference semantics: a failed duty is logged and the
+                # runner moves to the next slot — one flaky RPC (or a
+                # node shutting down under us) must not kill the
+                # validator process
+                print(f"slot {slot}: duty failed: "
+                      f"{type(e).__name__}: {e}", flush=True)
+                continue
             print(f"slot {slot}: proposed={vc.proposed} "
                   f"attested={vc.attested} "
                   f"aggregated={vc.aggregated}", flush=True)

@@ -1,0 +1,133 @@
+"""Remote-signer keymanager (Web3Signer-style).
+
+Reference analog: the validator's remote-signer keymanager, which
+delegates signing to an external HTTP signer service so validator
+keys never live in the validator-client process [U, SURVEY.md §2
+"validator" row].
+
+Protocol (the Web3Signer eth2 surface, minimally):
+  GET  /api/v1/eth2/publicKeys               -> ["0x...", ...]
+  POST /api/v1/eth2/sign/0x<pubkey>          body {"signing_root": "0x..."}
+       -> {"signature": "0x..."}             (404 unknown key,
+                                              400 malformed request)
+
+``RemoteSignerServer`` hosts a local ``KeyManager`` behind that
+surface; ``RemoteKeyManager`` is a drop-in keymanager for
+``ValidatorClient`` (same pubkeys/has/sign methods) that performs
+every signature over HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..crypto.bls import bls
+
+_PREFIX = "/api/v1/eth2"
+
+
+class RemoteSignerServer:
+    """Hosts a KeyManager behind the Web3Signer-style HTTP surface."""
+
+    def __init__(self, keymanager, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.km = keymanager
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):           # quiet
+                pass
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == f"{_PREFIX}/publicKeys":
+                    keys = ["0x" + pk.hex() for pk in outer.km.pubkeys()]
+                    return self._json(200, keys)
+                self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if not self.path.startswith(f"{_PREFIX}/sign/"):
+                    return self._json(404, {"error": "not found"})
+                try:
+                    pk = bytes.fromhex(
+                        self.path.rsplit("/", 1)[1].removeprefix("0x"))
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n))
+                    root = bytes.fromhex(
+                        req["signing_root"].removeprefix("0x"))
+                    if len(root) != 32:
+                        raise ValueError("signing_root must be 32 bytes")
+                except (ValueError, KeyError, json.JSONDecodeError) as e:
+                    return self._json(400, {"error": str(e)})
+                if not outer.km.has(pk):
+                    return self._json(404, {"error": "unknown pubkey"})
+                sig = outer.km.sign(pk, root)
+                self._json(200, {"signature": "0x" + sig.to_bytes().hex()})
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="remote-signer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+class RemoteKeyManager:
+    """KeyManager-compatible facade whose ``sign`` round-trips to a
+    remote signer; pubkeys are fetched once at construction (the
+    remote signer owns key lifecycle)."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self._pubkeys = [
+            bytes.fromhex(k.removeprefix("0x"))
+            for k in self._get(f"{_PREFIX}/publicKeys")]
+
+    def _get(self, path: str):
+        with urllib.request.urlopen(self.url + path,
+                                    timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def pubkeys(self) -> list[bytes]:
+        return list(self._pubkeys)
+
+    def has(self, pubkey: bytes) -> bool:
+        return pubkey in self._pubkeys
+
+    def sign(self, pubkey: bytes, signing_root: bytes) -> bls.Signature:
+        body = json.dumps(
+            {"signing_root": "0x" + signing_root.hex()}).encode()
+        req = urllib.request.Request(
+            f"{self.url}{_PREFIX}/sign/0x{pubkey.hex()}", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                resp = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            raise RemoteSignerError(
+                f"signer returned {e.code}: {e.read()[:200]!r}") from None
+        return bls.Signature.from_bytes(
+            bytes.fromhex(resp["signature"].removeprefix("0x")))

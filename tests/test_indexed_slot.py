@@ -1,0 +1,220 @@
+"""Device-native slot pipeline: registry pubkey table + indexed batch.
+
+VERDICT r4 #4: the per-slot path must run ZERO pure-Python EC math.
+These tests drive pool -> IndexedSlotBatch -> device verdict on the
+xla backend (virtual-CPU mesh) and cross-check the decompression
+primitives against the pure golden model.
+"""
+
+import numpy as np
+import pytest
+
+from prysm_tpu.config import (
+    set_features, use_mainnet_config, use_minimal_config,
+)
+from prysm_tpu.crypto.bls import bls
+from prysm_tpu.proto import Attestation, build_types
+from prysm_tpu.testing import util as testutil
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_xla():
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    yield
+    set_features(bls_implementation="pure")
+    use_mainnet_config()
+
+
+@pytest.fixture(scope="module")
+def types():
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    return build_types(MINIMAL_CONFIG)
+
+
+@pytest.fixture(scope="module")
+def genesis(types):
+    return testutil.deterministic_genesis_state(16, types)
+
+
+class TestDecompression:
+    def test_g1_matches_pure_and_rejects_tampering(self):
+        from prysm_tpu.crypto.bls.params import P
+        from prysm_tpu.crypto.bls.xla import compress as C
+        from prysm_tpu.crypto.bls.xla.curve import unpack_g1_points
+
+        kps = [bls.deterministic_keypair(i) for i in range(3)]
+        pks = [pk.to_bytes() for _, pk in kps]
+        inf_pk = bytes([0xC0]) + b"\x00" * 47
+        flip = bytearray(pks[0])
+        flip[0] ^= 0x20                       # sign flip: negated point
+        bigx = bytes([0x9F] + [0xFF] * 47)    # x >= P
+        x = 5
+        while pow((x**3 + 4) % P, (P - 1) // 2, P) == 1:
+            x += 1                            # non-residue rhs
+        noncurve = bytes([0x80 | x.to_bytes(48, "big")[0]]) \
+            + x.to_bytes(48, "big")[1:]
+        batch = pks + [inf_pk, bytes(flip), bigx, noncurve]
+        jac, ok = C.g1_decompress_batch(batch)
+        assert list(ok) == [True, True, True, True, True, False, False]
+        pts = unpack_g1_points(jac)
+        for i in range(3):
+            assert pts[i] == kps[i][1].point
+        assert pts[3] is None                 # canonical infinity
+        want = kps[0][1].point
+        assert pts[4] == (want[0], -want[1])  # flipped sign negates y
+        assert pts[5] is None and pts[6] is None  # fail-closed
+
+    def test_g1_rejects_non_subgroup_point(self):
+        from prysm_tpu.crypto.bls.params import P, R
+        from prysm_tpu.crypto.bls.pure import curve as pc
+        from prysm_tpu.crypto.bls.pure.fields import Fq
+        from prysm_tpu.crypto.bls.xla import compress as C
+
+        x = 3
+        while True:
+            rhs = (x**3 + 4) % P
+            if pow(rhs, (P - 1) // 2, P) == 1:
+                y = pow(rhs, (P + 1) // 4, P)
+                if pc.multiply((Fq(x), Fq(y)), R) is not None:
+                    break
+            x += 1
+        enc = bytearray(x.to_bytes(48, "big"))
+        enc[0] |= 0x80
+        if y > (P - 1) // 2:
+            enc[0] |= 0x20
+        # pad to the cached batch shape
+        inf_pk = bytes([0xC0]) + b"\x00" * 47
+        _, ok = C.g1_decompress_batch([bytes(enc)] + [inf_pk] * 6)
+        assert not ok[0]
+
+    def test_g2_matches_pure(self):
+        from prysm_tpu.crypto.bls.pure import signature as ps
+        from prysm_tpu.crypto.bls.xla import compress as C
+        from prysm_tpu.crypto.bls.xla.curve import unpack_g2_points
+
+        kps = [bls.deterministic_keypair(i) for i in range(3)]
+        msgs = [b"msg-%d" % i for i in range(3)]
+        sigs = [sk.sign(m).to_bytes() for (sk, _), m in zip(kps, msgs)]
+        inf_sig = bytes([0xC0]) + b"\x00" * 95
+        jac, ok = C.g2_decompress_batch(sigs + [inf_sig])
+        assert list(ok) == [True] * 4
+        pts = unpack_g2_points(jac)
+        for i in range(3):
+            assert pts[i] == ps.g2_from_bytes(sigs[i])
+        assert pts[3] is None
+
+
+class TestPubkeyTable:
+    def test_sync_and_growth(self, genesis):
+        table = bls.PubkeyTable()
+        table.sync(genesis.validators)
+        assert table.n == 16
+        x, y_, inf = table.arrays()
+        assert x.shape[0] >= 16
+        assert not bool(np.asarray(inf[:16]).any())
+        # idempotent
+        table.sync(genesis.validators)
+        assert table.n == 16
+
+    def test_invalid_pubkey_marks_inf(self, types):
+        st = testutil.deterministic_genesis_state(16, types)
+        st.validators[3].pubkey = b"\x11" * 48     # not a valid point
+        table = bls.PubkeyTable()
+        table.sync(st.validators)
+        _, _, inf = table.arrays()
+        inf = np.asarray(inf)
+        assert inf[3] and not inf[2]
+
+
+class TestIndexedSlotPipeline:
+    def _pool_with_atts(self, state, slot, committees):
+        from prysm_tpu.operations.attestations import AttestationPool
+
+        pool = AttestationPool()
+        for ci in committees:
+            att = testutil.valid_attestation(state, slot, ci)
+            pool.save_aggregated(att)
+        return pool
+
+    def test_happy_path_one_dispatch(self, genesis):
+        pool = self._pool_with_atts(genesis, 1, [0, 1])
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        assert len(batch) == 2
+        assert batch.verify()
+
+    def test_wrong_signature_fails_batch(self, genesis):
+        pool = self._pool_with_atts(genesis, 1, [0])
+        other = testutil.valid_attestation(genesis, 1, 1)
+        good = testutil.valid_attestation(genesis, 1, 0)
+        wrong = Attestation(aggregation_bits=good.aggregation_bits,
+                            data=good.data, signature=other.signature)
+        pool.save_aggregated(wrong)
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        assert not batch.verify()
+
+    def test_malformed_signature_fails_closed(self, genesis):
+        pool = self._pool_with_atts(genesis, 1, [0])
+        good = testutil.valid_attestation(genesis, 1, 1)
+        bad = Attestation(aggregation_bits=good.aggregation_bits,
+                          data=good.data, signature=b"\x13" * 96)
+        pool.save_aggregated(bad)
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        assert not batch.verify()
+
+    def test_empty_slot_is_true(self, genesis):
+        from prysm_tpu.operations.attestations import AttestationPool
+
+        pool = AttestationPool()
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        assert len(batch) == 0 and batch.verify()
+
+    def test_matches_object_batch_verdict(self, genesis):
+        """Indexed path and the object-based SignatureBatch agree."""
+        pool = self._pool_with_atts(genesis, 1, [0, 1])
+        indexed = pool.build_slot_batch_indexed(genesis, 1)
+        objb = pool.build_slot_signature_batch(genesis, 1)
+        assert indexed.verify() and objb.verify()
+
+    def test_sync_service_uses_indexed_path(self, genesis, types):
+        from prysm_tpu.p2p import GossipBus
+
+        from tests.test_node_services import make_node
+
+        bus = GossipBus()
+        chain, sync, peer, pool = make_node(bus, "ix", genesis, types)
+        att = testutil.valid_attestation(chain.head_state, 1, 0)
+        pool.save_aggregated(att)
+        assert sync.verify_slot_batch(1)
+        voted = set(chain.forkchoice.votes.keys())
+        from prysm_tpu.core.helpers import get_beacon_committee
+
+        signers = set(get_beacon_committee(chain.head_state, 1, 0))
+        assert signers <= voted
+
+
+class TestDeviceSyntheticBatch:
+    def test_device_keygen_matches_pure(self):
+        """The bench batch builder's device path (n >= 256) derives
+        the same pubkeys/signatures as the pure construction."""
+        from prysm_tpu.crypto.bls.pure import signature as ps
+        from prysm_tpu.crypto.bls.xla.curve import (
+            unpack_g1_points, unpack_g2_points,
+        )
+        from prysm_tpu.crypto.bls.xla.verify import slot_verify_device
+
+        batch = bls.build_synthetic_slot_batch(
+            n_committees=2, committee_size=128, cache_dir="/tmp/nope-x",
+            rlc_bits=8)
+        flat = tuple(
+            t.reshape((-1,) + t.shape[2:]) for t in batch["pk_jac"])
+        pts = unpack_g1_points(flat)
+        for i in (0, 1, 127, 128, 255):
+            want = ps.sk_to_pubkey_point(
+                ps.deterministic_secret_key(i))
+            assert pts[i] == want, f"pubkey {i} mismatch"
+        # and the batch as a whole verifies on device
+        assert bool(slot_verify_device(
+            batch["pk_jac"], batch["sig_jac"], batch["h_jac"],
+            batch["r_bits"]))

@@ -80,3 +80,76 @@ class TestKeyManagerIntegration:
         km.export_keystores(str(tmp_path), PASSWORD, kdf="pbkdf2")
         with pytest.raises(KeystoreError):
             KeyManager().import_keystores(str(tmp_path), "nope")
+
+
+class TestRemoteSigner:
+    """Web3Signer-style remote keymanager (SURVEY §2 validator row)."""
+
+    def test_sign_roundtrip_and_errors(self):
+        from prysm_tpu.crypto.bls import bls
+        from prysm_tpu.validator import (
+            KeyManager, RemoteKeyManager, RemoteSignerError,
+            RemoteSignerServer,
+        )
+
+        local = KeyManager.deterministic(3)
+        srv = RemoteSignerServer(local)
+        srv.start()
+        try:
+            remote = RemoteKeyManager(
+                f"http://{srv.host}:{srv.port}")
+            assert sorted(remote.pubkeys()) == sorted(local.pubkeys())
+            pk = local.pubkeys()[0]
+            root = b"\x5a" * 32
+            sig = remote.sign(pk, root)
+            # byte-identical to local signing
+            assert sig.to_bytes() == local.sign(pk, root).to_bytes()
+            assert bls.PublicKey.from_bytes(pk)
+            assert sig.verify(bls.PublicKey.from_bytes(pk), root)
+            # unknown key -> typed error, not a crash
+            import pytest as _pytest
+
+            with _pytest.raises(RemoteSignerError):
+                remote.sign(b"\x99" * 48, root)
+        finally:
+            srv.stop()
+
+    def test_duty_loop_with_remote_keymanager(self):
+        """The ENTIRE validator duty loop signing over HTTP — keys
+        never in the client process."""
+        from prysm_tpu.config import (
+            use_mainnet_config, use_minimal_config,
+        )
+
+        use_minimal_config()
+        try:
+            from prysm_tpu.config import MINIMAL_CONFIG
+            from prysm_tpu.node import BeaconNode
+            from prysm_tpu.p2p import GossipBus
+            from prysm_tpu.proto import build_types
+            from prysm_tpu.rpc import ValidatorAPI
+            from prysm_tpu.testing import util as testutil
+            from prysm_tpu.validator import (
+                KeyManager, RemoteKeyManager, RemoteSignerServer,
+                ValidatorClient,
+            )
+
+            types = build_types(MINIMAL_CONFIG)
+            genesis = testutil.deterministic_genesis_state(16, types)
+            node = BeaconNode(GossipBus(), "rs-node", genesis,
+                              types=types)
+            srv = RemoteSignerServer(KeyManager.deterministic(16))
+            srv.start()
+            try:
+                km = RemoteKeyManager(f"http://{srv.host}:{srv.port}")
+                vc = ValidatorClient(ValidatorAPI(node), km)
+                for slot in range(1, 3):
+                    vc.on_slot(slot)
+                    node.att_pool.aggregate_unaggregated()
+                    assert node.head_slot() == slot
+                assert vc.proposed == 2 and vc.attested > 0
+            finally:
+                srv.stop()
+                node.stop()
+        finally:
+            use_mainnet_config()

@@ -92,3 +92,124 @@ class TestSlasher:
         s = Slasher(4, history=64)
         with pytest.raises(ValueError):
             s.process_attestation(att([0], 1, 100), root(1))
+
+
+class TestPersistence:
+    def test_detection_state_survives_restart(self, tmp_path):
+        """slasherkv analog: the SAME offense detected by a FRESH
+        process from the DB alone (VERDICT r4 #8)."""
+        from prysm_tpu.db.kv import KVStore
+
+        path = str(tmp_path / "slasher.db")
+        store = KVStore(path)
+        s1 = Slasher(8, store=store)
+        assert s1.process_attestation(att([1, 2], 2, 3), root(1)) == []
+
+        # restart: brand-new Slasher over the same file
+        store2 = KVStore(path)
+        s2 = Slasher(8, store=store2)
+        hits = s2.process_attestation(att([1], 2, 3), root(9))
+        assert len(hits) == 1          # double vote vs the OLD record
+        sl = hits[0]
+        # evidence is the PRIOR vote, recovered from the DB
+        assert sl.attestation_1.data.target.epoch == 3
+        assert list(sl.attestation_1.attesting_indices) == [1, 2]
+        assert list(sl.attestation_2.attesting_indices) == [1]
+
+    def test_surround_detected_after_restart(self, tmp_path):
+        from prysm_tpu.db.kv import KVStore
+
+        path = str(tmp_path / "slasher2.db")
+        s1 = Slasher(8, store=KVStore(path))
+        s1.process_attestation(att([5], 2, 3), root(1))
+        s2 = Slasher(8, store=KVStore(path))
+        hits = s2.process_attestation(att([5], 1, 5), root(2))
+        assert len(hits) == 1          # surround vs the OLD vote
+
+    def test_span_rows_written_and_loadable(self, tmp_path):
+        from prysm_tpu.db.kv import KVStore
+        from prysm_tpu.slasher import SlasherKV
+
+        store = KVStore(str(tmp_path / "s.db"))
+        s = Slasher(4, history=64, store=store)
+        s.process_attestation(att([0, 2], 1, 2), root(1))
+        kv = SlasherKV(store)
+        row = kv.load_row(2, 64)
+        assert row is not None
+        assert kv.load_row(1, 64) is None    # untouched validator
+        votes = kv.votes_for(0)
+        assert len(votes) == 1 and votes[0][0] == 2
+
+
+class TestNodeWiring:
+    def test_double_vote_reaches_proposed_block(self, tmp_path):
+        """The full loop: gossip-verified double vote -> slasher ->
+        slashing pool -> attester_slashings in the next proposal."""
+        from prysm_tpu.config import (
+            set_features, use_mainnet_config, use_minimal_config,
+        )
+
+        use_minimal_config()
+        set_features(slasher=True)
+        try:
+            from prysm_tpu.config import MINIMAL_CONFIG
+            from prysm_tpu.node import BeaconNode
+            from prysm_tpu.p2p import GossipBus
+            from prysm_tpu.proto import Attestation, build_types
+            from prysm_tpu.rpc import ValidatorAPI
+            from prysm_tpu.testing import util as testutil
+
+            types = build_types(MINIMAL_CONFIG)
+            genesis = testutil.deterministic_genesis_state(16, types)
+            bus = GossipBus()
+            node = BeaconNode(bus, "slash-node", genesis, types=types,
+                              db_path=str(tmp_path / "node.db"))
+            assert node.slasher is not None
+
+            good = testutil.valid_attestation(genesis, 1, 0)
+            # same committee/target, different beacon_block_root,
+            # properly re-signed: a slashable double vote
+            from prysm_tpu.core.helpers import get_beacon_committee
+            from prysm_tpu.proto import AttestationData
+
+            committee = get_beacon_committee(genesis, 1, 0)
+            data2 = AttestationData(
+                slot=good.data.slot, index=good.data.index,
+                beacon_block_root=b"\x42" * 32,
+                source=good.data.source, target=good.data.target)
+            sig2 = testutil.sign_attestation_for_committee(
+                genesis, data2, committee)
+            evil = Attestation(
+                aggregation_bits=[True] * len(committee),
+                data=data2, signature=sig2)
+            node.att_pool.save_aggregated(good)
+            node.att_pool.save_aggregated(evil)
+            assert node.sync.verify_slot_batch(1)
+            assert node.slasher.detections >= 1
+            pending = node.slashing_pool.pending_attester_slashings()
+            assert len(pending) >= 1
+
+            # proposer packs it
+            api = ValidatorAPI(node)
+            from prysm_tpu.core.helpers import compute_signing_root
+            from prysm_tpu.core.transition import _Uint64Box
+            from prysm_tpu.config import beacon_config
+
+            cfg = beacon_config()
+            from prysm_tpu.core.helpers import get_domain
+
+            reveal = testutil.secret_key_for(0)  # placeholder key
+            duties = api.get_duties(0, [
+                testutil.secret_key_for(i).public_key().to_bytes()
+                for i in range(16)])
+            proposer = next(d for d in duties if 1 in d.proposer_slots)
+            dom = get_domain(genesis, cfg.domain_randao, 0)
+            sk = testutil.secret_key_for(proposer.validator_index)
+            randao = sk.sign(
+                compute_signing_root(_Uint64Box(0), dom)).to_bytes()
+            block = api.get_block_proposal(1, randao)
+            assert len(block.body.attester_slashings) >= 1
+            node.stop()
+        finally:
+            set_features(slasher=False)
+            use_mainnet_config()
