@@ -23,6 +23,7 @@ test-slow:
 # forward progress).  The dryrun warms the driver's multichip graphs
 # (same shapes as tests/test_multichip.py).
 warm-cache:
+	$(PY) -m prysm_tpu.tools.warm_indexed
 	for f in tests/test_*.py; do \
 		ok=0; \
 		for try in 1 2 3; do \

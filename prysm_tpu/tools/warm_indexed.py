@@ -1,0 +1,97 @@
+"""Warm the device-slot-pipeline compile caches, one graph per process.
+
+jaxlib segfaults non-deterministically in long-running XLA:CPU
+processes — either serializing a large executable into the
+persistent cache or even inside ``backend_compile_and_load`` once a
+process has many compiles behind it.  The test suite therefore runs
+with cache WRITES disabled (tests/conftest.py) and this tool
+populates the entries it reads: each heavy graph compiles in its own
+short-lived subprocess (phase), so a crash in one phase neither loses
+the others' cache writes nor blocks retries.  ``make warm-cache``
+runs it before the per-file pytest loop.
+
+Usage: python -m prysm_tpu.tools.warm_indexed [phase]
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+PHASES = ("indexed", "objbatch", "synthetic")
+
+
+def _run_phase(phase: str) -> None:
+    from ..utils import jaxenv
+
+    jaxenv.force_cpu(8)
+    jaxenv.use_cache(jaxenv.cpu_cache_dir(), write=True)
+
+    from ..config import set_features, use_minimal_config
+
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+
+    from ..config import MINIMAL_CONFIG
+    from ..proto import build_types
+    from ..testing import util as testutil
+
+    types = build_types(MINIMAL_CONFIG)
+    genesis = testutil.deterministic_genesis_state(16, types)
+
+    def slot_pool():
+        """The suite's slot-batch shape: 2 committees, slot 1."""
+        from ..operations.attestations import AttestationPool
+
+        pool = AttestationPool()
+        for ci in (0, 1):
+            pool.save_aggregated(
+                testutil.valid_attestation(genesis, 1, ci))
+        return pool
+
+    if phase == "indexed":
+        # gather/aggregate/RLC graph + g1/g2 decompress + h2c shapes
+        batch = slot_pool().build_slot_batch_indexed(genesis, 1)
+        assert batch.verify(), "indexed warm: valid slot rejected"
+    elif phase == "objbatch":
+        # object-form SignatureBatch RLC path at the suite's shape
+        objb = slot_pool().build_slot_signature_batch(genesis, 1)
+        assert objb.verify(), "objbatch warm: valid slot rejected"
+    elif phase == "synthetic":
+        # device keygen scan + slot_verify at the 2x128 test shape
+        from ..crypto.bls import bls
+        from ..crypto.bls.xla.verify import slot_verify_device
+
+        batch = bls.build_synthetic_slot_batch(
+            n_committees=2, committee_size=128,
+            cache_dir="/tmp/warm-synthetic-cache", rlc_bits=8)
+        ok = slot_verify_device(batch["pk_jac"], batch["sig_jac"],
+                                batch["h_jac"], batch["r_bits"])
+        assert bool(ok), "synthetic warm: valid batch rejected"
+    else:
+        raise SystemExit(f"unknown phase {phase!r}")
+    print(f"warm_indexed[{phase}]: OK", flush=True)
+
+
+def main() -> None:
+    if len(sys.argv) > 1:
+        _run_phase(sys.argv[1])
+        return
+    # parent mode: one subprocess per phase, retried (entries written
+    # before a crash persist, so retries make forward progress)
+    for phase in PHASES:
+        for attempt in range(3):
+            rc = subprocess.call(
+                [sys.executable, "-m", "prysm_tpu.tools.warm_indexed",
+                 phase])
+            if rc == 0:
+                break
+            print(f"# phase {phase} attempt {attempt + 1} rc={rc} "
+                  "(retrying)", flush=True)
+        else:
+            raise SystemExit(f"warm phase {phase} failed 3x")
+    print("warm_indexed: ALL OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

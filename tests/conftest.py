@@ -28,3 +28,19 @@ import jax  # noqa: E402  (after env setup, before any test imports)
 
 assert jax.devices()[0].platform == "cpu"
 assert len(jax.devices()) == 8, jax.devices()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _shed_compiled_executables():
+    """Drop live compiled executables after each test module.
+
+    jaxlib segfaults once a single process accumulates enough loaded
+    XLA:CPU AOT executables (observed deterministically ~30+ tests
+    into any multi-file run on this image, in compile, serialize, OR
+    cache-load paths).  Releasing executables at module boundaries
+    keeps the live count low; subsequent modules re-load from the
+    persistent cache in seconds."""
+    yield
+    jax.clear_caches()
