@@ -18,7 +18,7 @@ from __future__ import annotations
 import subprocess
 import sys
 
-PHASES = ("indexed", "objbatch", "synthetic")
+PHASES = ("indexed", "objbatch", "synthetic", "rlc8")
 
 
 def _run_phase(phase: str) -> None:
@@ -57,6 +57,18 @@ def _run_phase(phase: str) -> None:
         # object-form SignatureBatch RLC path at the suite's shape
         objb = slot_pool().build_slot_signature_batch(genesis, 1)
         assert objb.verify(), "objbatch warm: valid slot rejected"
+    elif phase == "rlc8":
+        # the 8-entry SignatureBatch RLC graph (test_bls_facade's
+        # TestSignatureBatch shape) — its serialize crashes inside a
+        # full pytest-file process more often than not
+        from ..crypto.bls import bls
+
+        batch = bls.SignatureBatch()
+        for i in range(8):
+            sk, pk = bls.deterministic_keypair(8800 + i)
+            msg = bytes([i]) * 32
+            batch.add(sk.sign(msg), msg, pk, f"warm-{i}")
+        assert batch.verify(), "rlc8 warm: valid batch rejected"
     elif phase == "synthetic":
         # device keygen scan + slot_verify at the 2x128 test shape
         from ..crypto.bls import bls
