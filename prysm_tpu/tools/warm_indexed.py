@@ -32,30 +32,32 @@ def _run_phase(phase: str) -> None:
     use_minimal_config()
     set_features(bls_implementation="xla")
 
-    from ..config import MINIMAL_CONFIG
-    from ..proto import build_types
-    from ..testing import util as testutil
-
-    types = build_types(MINIMAL_CONFIG)
-    genesis = testutil.deterministic_genesis_state(16, types)
-
-    def slot_pool():
-        """The suite's slot-batch shape: 2 committees, slot 1."""
+    def slot_fixture():
+        """The suite's slot-batch shape: 16-validator genesis, slot 1,
+        2 committees.  Built lazily — only the pool-based phases pay
+        for the genesis/type setup."""
+        from ..config import MINIMAL_CONFIG
         from ..operations.attestations import AttestationPool
+        from ..proto import build_types
+        from ..testing import util as testutil
 
+        types = build_types(MINIMAL_CONFIG)
+        genesis = testutil.deterministic_genesis_state(16, types)
         pool = AttestationPool()
         for ci in (0, 1):
             pool.save_aggregated(
                 testutil.valid_attestation(genesis, 1, ci))
-        return pool
+        return pool, genesis
 
     if phase == "indexed":
         # gather/aggregate/RLC graph + g1/g2 decompress + h2c shapes
-        batch = slot_pool().build_slot_batch_indexed(genesis, 1)
+        pool, genesis = slot_fixture()
+        batch = pool.build_slot_batch_indexed(genesis, 1)
         assert batch.verify(), "indexed warm: valid slot rejected"
     elif phase == "objbatch":
         # object-form SignatureBatch RLC path at the suite's shape
-        objb = slot_pool().build_slot_signature_batch(genesis, 1)
+        pool, genesis = slot_fixture()
+        objb = pool.build_slot_signature_batch(genesis, 1)
         assert objb.verify(), "objbatch warm: valid slot rejected"
     elif phase == "rlc8":
         # the 8-entry SignatureBatch RLC graph (test_bls_facade's
