@@ -22,7 +22,7 @@ import time
 from ..core.helpers import get_attesting_indices
 from ..core.transition import (
     StateTransitionError, collect_block_signature_batch,
-    state_transition,
+    collect_block_signature_batch_indexed, state_transition,
 )
 from ..forkchoice import ForkChoiceStore
 from ..blockchain.events import (
@@ -55,6 +55,12 @@ class BlockchainService:
 
         self.head_root = genesis_root
         self.head_state = genesis_state.copy()
+        # device-resident registry pubkey table for the indexed block
+        # batch path: synced incrementally per block, shared across the
+        # service's whole lifetime (lazy: empty under the pure backend)
+        from ..crypto.bls import bls as _bls
+
+        self.pubkey_table = _bls.PubkeyTable()
         self.justified_checkpoint = genesis_state.current_justified_checkpoint
         self.finalized_checkpoint = genesis_state.finalized_checkpoint
 
@@ -90,8 +96,18 @@ class BlockchainService:
                     from ..core.transition import process_slots
 
                     process_slots(pre_state, block.slot, self.types)
-                batch = collect_block_signature_batch(pre_state,
-                                                      signed_block)
+                from ..config import features
+
+                if features().bls_implementation in ("xla", "pallas"):
+                    # device-native: signer index rows into the
+                    # service's persistent PubkeyTable; decompression
+                    # + hash-to-curve + aggregate + pairing check fuse
+                    # into ONE dispatch per block
+                    batch = collect_block_signature_batch_indexed(
+                        pre_state, signed_block, self.pubkey_table)
+                else:
+                    batch = collect_block_signature_batch(pre_state,
+                                                          signed_block)
             except (ValueError, StateTransitionError) as e:
                 # malformed signature/pubkey bytes or bad structure
                 raise BlockProcessingError(

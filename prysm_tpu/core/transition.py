@@ -418,3 +418,56 @@ def collect_block_signature_batch(state, signed_block) -> "bls.SignatureBatch":
         batch.add(bls.Signature.from_bytes(att.signature), root,
                   bls.PublicKey.aggregate(pks), "attestation")
     return batch
+
+
+def collect_block_signature_batch_indexed(state, signed_block, table):
+    """Device-native ``collect_block_signature_batch``: the block's
+    proposer, randao, and attestation signature work as signer INDEX
+    ROWS into a device-resident registry table (``bls.PubkeyTable``) —
+    no pure-Python pubkey decompression or aggregation anywhere on the
+    path.  ``table.sync`` transfers only new/changed rows, so replaying
+    thousands of blocks against one table pays the key decompression
+    cost once instead of re-deriving PublicKey objects per block (the
+    pure ``from_bytes`` subgroup check is ~0.1 s/key — the whole
+    epoch_replay_16k timeout).  The returned ``IndexedSlotBatch``
+    verifies everything in ONE device dispatch."""
+    import numpy as np
+
+    from ..operations.attestations import (
+        IndexedSlotBatch, _pack_index_rows,
+    )
+
+    cfg = beacon_config()
+    table.sync(state.validators)
+    block = signed_block.message
+    rows, roots, sigs, descs = [], [], [], []
+
+    pi = np.asarray([block.proposer_index], dtype=np.int32)
+    domain = get_domain(state, cfg.domain_beacon_proposer)
+    rows.append(pi)
+    roots.append(compute_signing_root(block, domain))
+    sigs.append(bytes(signed_block.signature))
+    descs.append("block proposer")
+
+    epoch = compute_epoch_at_slot(block.slot)
+    randao_domain = get_domain(state, cfg.domain_randao, epoch)
+    rows.append(pi)
+    roots.append(compute_signing_root(_Uint64Box(epoch), randao_domain))
+    sigs.append(bytes(block.body.randao_reveal))
+    descs.append("randao")
+
+    for att in block.body.attestations:
+        indexed = get_indexed_attestation(state, att)
+        att_domain = get_domain(state, cfg.domain_beacon_attester,
+                                att.data.target.epoch)
+        rows.append(np.asarray(indexed.attesting_indices,
+                               dtype=np.int32))
+        roots.append(compute_signing_root(att.data, att_domain))
+        sigs.append(bytes(att.signature))
+        descs.append("attestation")
+
+    idx, mask = _pack_index_rows(rows)
+    return IndexedSlotBatch(idx=idx, mask=mask, roots=roots,
+                            sig_bytes=sigs, descriptions=descs,
+                            table=table,
+                            attestations=list(block.body.attestations))

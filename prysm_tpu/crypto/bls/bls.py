@@ -242,7 +242,33 @@ class PubkeyTable:
     def reset(self) -> None:
         self.__init__()
 
-    def sync(self, validators) -> None:
+    def _decompress_rows(self, pubs: list[bytes]):
+        """Batched decompress of ``pubs`` -> (X, Y, inf) device arrays
+        trimmed to len(pubs) (the dispatch itself is bucket-padded so
+        deposit batches of nearby sizes share one compiled graph)."""
+        from .xla import limbs as L
+        from .xla.compress import g1_decompress_batch
+
+        import jax.numpy as jnp
+
+        nb = _bucket(len(pubs))
+        inf_enc = bytes([0xC0]) + b"\x00" * 47
+        jac, ok = g1_decompress_batch(
+            pubs + [inf_enc] * (nb - len(pubs)))
+        X, Y, Z = jac
+        inf = jnp.asarray(~np.asarray(ok)) | L.fp_is_zero(Z)
+        return X[:len(pubs)], Y[:len(pubs)], inf[:len(pubs)]
+
+    def sync(self, validators, changed=()) -> None:
+        """Bring the device table up to date with ``validators``.
+
+        Steady state (no registry growth) is ZERO transfers and zero
+        device work: the packed arrays stay committed on device
+        between dispatches.  Appends move only the new rows' worth of
+        bytes; ``changed`` names already-synced indices whose pubkey
+        was replaced in place (fork-choice handover between forks with
+        equal-length registries) — those rows re-decompress and
+        scatter without touching the rest of the table."""
         n = len(validators)
         if n == 0:
             return
@@ -255,21 +281,26 @@ class PubkeyTable:
                 # us: rebuild from scratch (rare — deposit-tail reorg)
                 self.reset()
                 return self.sync(validators)
+        changed = [i for i in changed if i < self.n]
+        if changed:
+            X, Y, inf = self._decompress_rows(
+                [bytes(validators[i].pubkey) for i in changed])
+            import jax.numpy as jnp
+
+            rows = jnp.asarray(np.asarray(changed, dtype=np.int32))
+            self._x = self._x.at[rows].set(X)
+            self._y = self._y.at[rows].set(Y)
+            self._inf = self._inf.at[rows].set(inf)
+            self._count_synced(len(changed), self.n)
         if n <= self.n:
             return
-        from .xla import limbs as L
-        from .xla.compress import g1_decompress_batch
-
+        import jax
         import jax.numpy as jnp
 
+        from .xla import limbs as L
+
         pubs = [bytes(validators[i].pubkey) for i in range(self.n, n)]
-        nb = _bucket(len(pubs))
-        inf_enc = bytes([0xC0]) + b"\x00" * 47
-        jac, ok = g1_decompress_batch(
-            pubs + [inf_enc] * (nb - len(pubs)))
-        X, Y, Z = jac
-        inf = jnp.asarray(~np.asarray(ok)) | L.fp_is_zero(Z)
-        X, Y, inf = X[:len(pubs)], Y[:len(pubs)], inf[:len(pubs)]
+        X, Y, inf = self._decompress_rows(pubs)
         cap = _bucket(n)
         if cap != self._cap or self._x is None:
             old_x = (self._x[:self.n] if self._x is not None
@@ -279,12 +310,19 @@ class PubkeyTable:
             old_inf = (self._inf[:self.n] if self._inf is not None
                        else jnp.zeros((0,), bool))
             grow = cap - self.n - len(pubs)
-            self._x = jnp.concatenate(
-                [old_x, X, jnp.zeros((grow, L.NLIMBS), jnp.uint32)])
-            self._y = jnp.concatenate(
-                [old_y, Y, jnp.zeros((grow, L.NLIMBS), jnp.uint32)])
-            self._inf = jnp.concatenate(
-                [old_inf, inf, jnp.ones((grow,), bool)])
+            # commit the grown table to a concrete device so every
+            # subsequent verify dispatch reads resident buffers — an
+            # uncommitted array can be re-staged per dispatch under
+            # sharding-mismatch fallbacks
+            dev = jax.devices()[0]
+            self._x = jax.device_put(jnp.concatenate(
+                [old_x, X, jnp.zeros((grow, L.NLIMBS), jnp.uint32)]),
+                dev)
+            self._y = jax.device_put(jnp.concatenate(
+                [old_y, Y, jnp.zeros((grow, L.NLIMBS), jnp.uint32)]),
+                dev)
+            self._inf = jax.device_put(jnp.concatenate(
+                [old_inf, inf, jnp.ones((grow,), bool)]), dev)
             self._cap = cap
         else:
             sl = slice(self.n, self.n + len(pubs))
@@ -293,10 +331,23 @@ class PubkeyTable:
             self._inf = self._inf.at[sl].set(inf)
         self.n = n
         self._tail = bytes(validators[n - 1].pubkey)
+        self._count_synced(len(pubs), n)
+
+    def _count_synced(self, rows: int, total: int) -> None:
+        from ...monitoring.metrics import metrics as _m
+
+        _m.inc("pubkey_table_rows_synced", rows)
+        _m.set("pubkey_table_rows", total)
 
     def arrays(self):
         """(x, y, inf) device arrays, bucketed capacity."""
         return self._x, self._y, self._inf
+
+    def nbytes(self) -> int:
+        """Device footprint of the resident table (metrics/debug)."""
+        if self._x is None:
+            return 0
+        return int(self._x.nbytes + self._y.nbytes + self._inf.nbytes)
 
 
 def verify_multiple_signatures(batch: SignatureBatch, rng=None) -> bool:
@@ -508,14 +559,20 @@ def build_synthetic_slot_batch(n_committees: int, committee_size: int,
         f"{suffix}.npz")
     if os.path.exists(cache_path):
         try:
+            import jax
+
             z = np.load(cache_path)
+            # COMMIT the big operands to a concrete device: an
+            # uncommitted array can be re-staged through the transport
+            # per dispatch under sharding-mismatch fallbacks, charging
+            # the ~MB pk batch to every timed iteration
+            dev = jax.devices()[0]
+            put = lambda a: jax.device_put(jnp.asarray(a), dev)  # noqa: E731
             return {
-                "pk_jac": tuple(jnp.asarray(z[f"pk{i}"])
-                                for i in range(3)),
-                "sig_jac": tuple(jnp.asarray(z[f"sig{i}"])
-                                 for i in range(3)),
-                "h_jac": tuple(jnp.asarray(z[f"h{i}"]) for i in range(3)),
-                "r_bits": jnp.asarray(z["r_bits"]),
+                "pk_jac": tuple(put(z[f"pk{i}"]) for i in range(3)),
+                "sig_jac": tuple(put(z[f"sig{i}"]) for i in range(3)),
+                "h_jac": tuple(put(z[f"h{i}"]) for i in range(3)),
+                "r_bits": put(z["r_bits"]),
                 "n_committees": n_committees,
                 "committee_size": committee_size,
             }

@@ -1,0 +1,143 @@
+"""Double-buffered async slot-verify dispatch.
+
+JAX dispatch is asynchronous: a jitted call enqueues device work and
+returns a future-backed array immediately; the host only blocks when
+it reads the result back.  The slot pipeline previously never used
+that — ``build -> verify -> bool(...)`` read every verdict back before
+packing the next slot, so the host packing of slot N+1 (byte parsing,
+expand_message_xmd hashing, index padding) serialized behind the
+in-flight device verify of slot N.
+
+``SlotDispatcher`` makes the overlap explicit and safe:
+
+* ``submit(work)`` runs the host-side packing + device dispatch NOW
+  (so the device starts) and returns a ticket; the caller goes on to
+  pack the next slot while the device crunches.
+* ``result(ticket)`` blocks on the readback.  Results are returned in
+  SUBMISSION ORDER — a consensus client must apply slot N's verdict
+  before slot N+1's.
+* exceptions raised by ``work`` (host packing errors, device aborts)
+  are captured at submit time and re-raised from ``result`` of that
+  ticket, so the pipeline's error surface is unchanged.
+* a dispatch abandoned mid-flight (``close()`` before its result was
+  claimed, or an explicit ``abandon``) resolves FAIL-CLOSED: its
+  verdict is False, never "silently assumed verified".  An abandoned
+  attestation batch therefore falls back to the caller's
+  per-attestation recovery path instead of counting votes unchecked.
+
+``max_in_flight`` bounds device queue depth (default 2: classic
+double buffering — one batch verifying, one being packed).  Submit
+blocks (completing the oldest readback into the results buffer) when
+the bound is hit, so an unbounded producer cannot pile up device
+memory.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+_PENDING = object()
+_ABANDONED = object()
+
+
+class SlotDispatcher:
+    def __init__(self, max_in_flight: int = 2):
+        assert max_in_flight >= 1
+        self.max_in_flight = max_in_flight
+        self._lock = threading.Lock()
+        self._next_ticket = 0
+        self._next_result = 0
+        # ticket -> ("ok", device_value) | ("err", exc) | resolved bool
+        self._entries: OrderedDict[int, object] = OrderedDict()
+        self._closed = False
+
+    # --- producer side -----------------------------------------------------
+
+    def submit(self, work) -> int:
+        """Run ``work()`` (host packing + async device dispatch) and
+        track its in-flight result.  Returns the ticket to pass to
+        ``result``.  ``work`` must return the UN-read-back device
+        value (or any value; host values pass straight through)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("dispatcher is closed")
+            in_flight = sum(
+                1 for v in self._entries.values()
+                if isinstance(v, tuple) and v[0] == "ok")
+            ticket = self._next_ticket
+            self._next_ticket += 1
+        if in_flight >= self.max_in_flight:
+            # drain the oldest in-flight readback into the buffer so
+            # the device queue stays bounded
+            self._drain_oldest()
+        try:
+            value = ("ok", work())
+        except Exception as e:          # noqa: BLE001 — repropagated
+            value = ("err", e)
+        with self._lock:
+            self._entries[ticket] = value
+        return ticket
+
+    def _drain_oldest(self) -> None:
+        import numpy as np
+
+        with self._lock:
+            target = None
+            for t, v in self._entries.items():
+                if isinstance(v, tuple) and v[0] == "ok":
+                    target = t
+                    break
+            if target is None:
+                return
+            tag, dev = self._entries[target]
+        resolved = bool(np.asarray(dev))
+        with self._lock:
+            if self._entries.get(target, _ABANDONED) is not _ABANDONED:
+                self._entries[target] = resolved
+
+    # --- consumer side -----------------------------------------------------
+
+    def result(self, ticket: int) -> bool:
+        """Verdict for ``ticket``.  Must be claimed in submission
+        order; raises the work's exception if it failed, returns
+        False (fail-closed) if the dispatch was abandoned."""
+        import numpy as np
+
+        with self._lock:
+            if ticket != self._next_result:
+                raise RuntimeError(
+                    f"results must be claimed in submission order "
+                    f"(expected ticket {self._next_result}, "
+                    f"got {ticket})")
+            entry = self._entries.pop(ticket, _PENDING)
+            self._next_result += 1
+        if entry is _PENDING:
+            raise KeyError(f"unknown ticket {ticket}")
+        if entry is _ABANDONED:
+            return False                 # fail-closed
+        if isinstance(entry, bool):
+            return entry                 # drained by the buffer bound
+        tag, payload = entry
+        if tag == "err":
+            raise payload
+        return bool(np.asarray(payload))
+
+    def abandon(self, ticket: int) -> None:
+        """Mark an in-flight dispatch abandoned: its ``result`` is
+        False, its device value is never read back."""
+        with self._lock:
+            if ticket in self._entries:
+                self._entries[ticket] = _ABANDONED
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def close(self) -> None:
+        """Abandon every unclaimed dispatch (their results become
+        fail-closed False) and refuse further submits."""
+        with self._lock:
+            self._closed = True
+            for t in list(self._entries):
+                self._entries[t] = _ABANDONED

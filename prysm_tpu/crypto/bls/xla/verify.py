@@ -171,22 +171,10 @@ def slot_verify_device(pk_jac, sig_jac, h_jac, r_bits):
     return _pairing_check(p_x, p_y, qx, qy, mask)
 
 
-@jax.jit
-def indexed_slot_verify_device(pk_x, pk_y, pk_inf, idx, idx_mask,
-                               sig_jac, h_jac, r_bits, att_mask):
-    """The pool -> verdict slot dispatch with ZERO host point math:
-    per-attestation signer sets arrive as INDEX ROWS into the
-    registry-wide packed pubkey table, and the aggregate public keys
-    are computed on device (gather + masked Jacobian sum tree) inside
-    the same graph as the RLC pairing check.
-
-    pk_x/pk_y: (N, 24) Montgomery affine registry table;
-    pk_inf: (N,) bool (invalid/infinity table entries — their lanes
-    aggregate as identity, so a signer with a bad key FAILS its
-    attestation rather than being skipped);
-    idx: (A, K) int32 signer indices; idx_mask: (A, K) bool;
-    sig_jac: (A,) G2 Jacobian signatures; h_jac: (A,) G2 message
-    hashes; r_bits: uint32 (nbits, A); att_mask: (A,) bool."""
+def _indexed_verify_core(pk_x, pk_y, pk_inf, idx, idx_mask,
+                         sig_jac, h_jac, r_bits, att_mask):
+    """Traced body shared by ``indexed_slot_verify_device`` and the
+    fused pool->verdict dispatch (``fused_slot_verify_device``)."""
     gx = jnp.take(pk_x, idx, axis=0)             # (A, K, 24)
     gy = jnp.take(pk_y, idx, axis=0)
     dead = jnp.take(pk_inf, idx, axis=0) | ~idx_mask
@@ -218,7 +206,75 @@ def indexed_slot_verify_device(pk_x, pk_y, pk_inf, idx, idx_mask,
     return ok & ~bad_apk
 
 
+@jax.jit
+def indexed_slot_verify_device(pk_x, pk_y, pk_inf, idx, idx_mask,
+                               sig_jac, h_jac, r_bits, att_mask):
+    """The pool -> verdict slot dispatch with ZERO host point math:
+    per-attestation signer sets arrive as INDEX ROWS into the
+    registry-wide packed pubkey table, and the aggregate public keys
+    are computed on device (gather + masked Jacobian sum tree) inside
+    the same graph as the RLC pairing check.
+
+    pk_x/pk_y: (N, 24) Montgomery affine registry table;
+    pk_inf: (N,) bool (invalid/infinity table entries — their lanes
+    aggregate as identity, so a signer with a bad key FAILS its
+    attestation rather than being skipped);
+    idx: (A, K) int32 signer indices; idx_mask: (A, K) bool;
+    sig_jac: (A,) G2 Jacobian signatures; h_jac: (A,) G2 message
+    hashes; r_bits: uint32 (nbits, A); att_mask: (A,) bool."""
+    return _indexed_verify_core(pk_x, pk_y, pk_inf, idx, idx_mask,
+                                sig_jac, h_jac, r_bits, att_mask)
+
+
+@jax.jit
+def fused_slot_verify_device(pk_x, pk_y, pk_inf, idx, idx_mask,
+                             sig_x, sig_i, sig_s, sig_wf, u0, u1,
+                             r_bits, att_mask):
+    """The WHOLE pool->verdict slot path as ONE device dispatch:
+    signature G2 decompression + subgroup checks, hash-to-G2 of the
+    signing roots, the registry gather/aggregate, and the RLC pairing
+    check fuse into a single jit graph.
+
+    The split path (g2_decompress_batch -> hash_to_g2 ->
+    indexed_slot_verify_device) paid the per-dispatch environment
+    floor THREE times per slot plus a host readback of the signature
+    validity mask between the first two; BREAKDOWN.json puts that
+    floor at ~93 ms on the axon tunnel — most of the measured 487.8 ms
+    pool->verdict latency for only ~63 ms of device compute.
+
+    Inputs beyond indexed_slot_verify_device's:
+    sig_x: (A, 2, 24) parsed signature x limbs (parse_g2_compressed);
+    sig_i/sig_s/sig_wf: (A,) bool infinity/sign/well-formed flags;
+    u0/u1: (A, 2, 24) hash-to-field outputs (host SHA-256, device
+    curve math).
+
+    Fail-closed: a live attestation whose signature fails
+    decompression (malformed, out of field, off curve, out of the
+    r-subgroup) rejects the WHOLE batch — same semantics the split
+    path enforced via the host-side ``sig_ok`` readback, now inside
+    the graph with no extra dispatch."""
+    from .compress import g2_decompress_device
+    from .h2c import hash_to_g2_device
+
+    sig_jac, sig_ok = g2_decompress_device(sig_x, sig_i, sig_s, sig_wf)
+    h_jac = hash_to_g2_device(u0, u1)
+    ok = _indexed_verify_core(pk_x, pk_y, pk_inf, idx, idx_mask,
+                              sig_jac, h_jac, r_bits, att_mask)
+    bad_sig = jnp.any(att_mask & ~sig_ok)
+    return ok & ~bad_sig
+
+
 _SHARDED_CACHE: dict = {}
+
+
+def _make_sharded_slot_verify(mesh):
+    """A NAMED jit entry per mesh (the anonymous ``jit__lambda`` hid
+    this graph in compile logs and slow-compile alarms — the
+    multichip r04 timeout was unattributable from its own tail)."""
+    def sharded_slot_verify_pipeline(pk, sig, h, rb):
+        return _sharded_slot_verify_traced(mesh, pk, sig, h, rb)
+
+    return jax.jit(sharded_slot_verify_pipeline)
 
 
 def sharded_slot_verify(mesh, pk_jac, sig_jac, h_jac, r_bits):
@@ -236,9 +292,7 @@ def sharded_slot_verify(mesh, pk_jac, sig_jac, h_jac, r_bits):
     dryrun's wall clock."""
     key = mesh
     if key not in _SHARDED_CACHE:
-        _SHARDED_CACHE[key] = jax.jit(
-            lambda pk, sig, h, rb: _sharded_slot_verify_traced(
-                mesh, pk, sig, h, rb))
+        _SHARDED_CACHE[key] = _make_sharded_slot_verify(mesh)
     return _SHARDED_CACHE[key](pk_jac, sig_jac, h_jac, r_bits)
 
 

@@ -149,6 +149,71 @@ class MetricsRegistry:
 metrics = MetricsRegistry()
 
 
+# --- jit compile counter ----------------------------------------------------
+#
+# The slot-verify latency path is only as fast as its jit cache: a
+# shape that misses the bucket set recompiles a multi-second XLA
+# graph in the middle of a slot.  This hook counts backend compiles
+# through jax.monitoring so (a) the ``jit_backend_compiles`` counter
+# is scrape-visible in production and (b) tests can assert that
+# repeated slots of differing committee counts inside one bucket
+# shape compile exactly once (tests/test_indexed_slot.py).
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compile_listener_installed = False
+
+
+def install_compile_counter() -> Counter:
+    """Register (once) a jax.monitoring listener that increments the
+    ``jit_backend_compiles`` counter on every XLA backend compile.
+    Returns the counter.  Safe to call before/without jax: the import
+    happens here, not at module load."""
+    global _compile_listener_installed
+    counter = metrics.counter(
+        "jit_backend_compiles",
+        "XLA backend compiles in this process (recompile guard)")
+    if _compile_listener_installed:
+        return counter
+    import jax.monitoring
+
+    def _on_event(name: str, duration: float, **kw) -> None:
+        if name == _COMPILE_EVENT:
+            counter.inc()
+            metrics.observe("jit_backend_compile_seconds", duration)
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event)
+    _compile_listener_installed = True
+    return counter
+
+
+class compile_guard:
+    """Context manager asserting at most ``allowed`` new XLA backend
+    compiles happen inside the block:
+
+        with compile_guard(allowed=0):
+            batch.verify()     # must hit the jit cache
+
+    ``hits`` carries the observed count for callers that want the
+    number rather than the assertion (pass ``allowed=None``)."""
+
+    def __init__(self, allowed: int | None = 0):
+        self.allowed = allowed
+        self.hits = 0
+
+    def __enter__(self) -> "compile_guard":
+        self._counter = install_compile_counter()
+        self._start = self._counter.value
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.hits = int(self._counter.value - self._start)
+        if exc_type is None and self.allowed is not None:
+            assert self.hits <= self.allowed, (
+                f"recompile guard: {self.hits} backend compiles "
+                f"(allowed {self.allowed}) — a stable-shape dispatch "
+                f"path is recompiling per slot")
+
+
 # --- prometheus_client bridge ----------------------------------------------
 #
 # The reference exposes its metrics through the standard prometheus

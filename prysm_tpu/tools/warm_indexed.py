@@ -50,7 +50,9 @@ def _run_phase(phase: str) -> None:
         return pool, genesis
 
     if phase == "indexed":
-        # gather/aggregate/RLC graph + g1/g2 decompress + h2c shapes
+        # the FUSED pool->verdict graph (decompress + subgroup + h2c +
+        # gather/aggregate + RLC pairing in one jit) + the g1
+        # decompress shapes the PubkeyTable sync dispatches
         pool, genesis = slot_fixture()
         batch = pool.build_slot_batch_indexed(genesis, 1)
         assert batch.verify(), "indexed warm: valid slot rejected"

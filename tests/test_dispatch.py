@@ -1,0 +1,84 @@
+"""SlotDispatcher: double-buffered async slot-verify dispatch.
+
+The pipeline contract (crypto/bls/xla/dispatch.py): results come back
+in submission order, work exceptions surface at ``result`` of their
+own ticket, and any dispatch nobody claims resolves FAIL-CLOSED
+(False) — an abandoned attestation batch must never count as verified.
+
+Only trivial jit graphs here: this file runs as its own suite shard
+and must not add large cold compiles to the tier-1 budget.
+"""
+
+import numpy as np
+import pytest
+
+from prysm_tpu.crypto.bls.xla.dispatch import SlotDispatcher
+
+
+def test_results_come_back_in_submission_order():
+    d = SlotDispatcher()
+    t0 = d.submit(lambda: True)
+    t1 = d.submit(lambda: False)
+    with pytest.raises(RuntimeError, match="submission order"):
+        d.result(t1)
+    assert d.result(t0) is True
+    assert d.result(t1) is False
+
+
+def test_device_value_reads_back_at_result():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: jnp.all(x > 0))
+    d = SlotDispatcher()
+    t0 = d.submit(lambda: f(jnp.ones(4)))
+    t1 = d.submit(lambda: f(jnp.asarray([1.0, -1.0, 2.0, 3.0])))
+    assert d.result(t0) is True
+    assert d.result(t1) is False
+
+
+def test_work_exception_propagates_from_result():
+    d = SlotDispatcher()
+
+    def boom():
+        raise ValueError("pack failed")
+
+    t0 = d.submit(boom)
+    t1 = d.submit(lambda: True)
+    with pytest.raises(ValueError, match="pack failed"):
+        d.result(t0)
+    # a failed slot must not poison the slots behind it
+    assert d.result(t1) is True
+
+
+def test_abandoned_dispatch_is_fail_closed():
+    d = SlotDispatcher()
+    t0 = d.submit(lambda: True)   # the device would say True...
+    d.abandon(t0)
+    assert d.result(t0) is False  # ...but nobody read it: False
+
+
+def test_close_abandons_unclaimed_and_refuses_submit():
+    d = SlotDispatcher()
+    t0 = d.submit(lambda: True)
+    t1 = d.submit(lambda: True)
+    d.close()
+    assert d.result(t0) is False
+    assert d.result(t1) is False
+    with pytest.raises(RuntimeError, match="closed"):
+        d.submit(lambda: True)
+
+
+def test_in_flight_bound_drains_oldest():
+    d = SlotDispatcher(max_in_flight=1)
+    t0 = d.submit(lambda: np.asarray(True))
+    t1 = d.submit(lambda: True)   # bound hit: t0 drains to a bool
+    assert d.pending() == 2       # both still unclaimed
+    assert d.result(t0) is True
+    assert d.result(t1) is True
+
+
+def test_unknown_ticket_raises():
+    d = SlotDispatcher()
+    with pytest.raises(RuntimeError, match="submission order"):
+        d.result(3)

@@ -106,6 +106,13 @@ class TestDecompression:
         assert pts[3] is None
 
 
+class _Val:
+    """Minimal validator stand-in: sync() only reads .pubkey."""
+
+    def __init__(self, pubkey: bytes):
+        self.pubkey = pubkey
+
+
 class TestPubkeyTable:
     def test_sync_and_growth(self, genesis):
         table = bls.PubkeyTable()
@@ -126,6 +133,68 @@ class TestPubkeyTable:
         _, _, inf = table.arrays()
         inf = np.asarray(inf)
         assert inf[3] and not inf[2]
+
+    def test_incremental_append_moves_only_new_rows(self, genesis):
+        from prysm_tpu.monitoring.metrics import metrics
+
+        table = bls.PubkeyTable()
+        table.sync(genesis.validators)
+        base = np.asarray(table.arrays()[0][:16]).copy()
+        synced0 = metrics.counter("pubkey_table_rows_synced").value
+        vals = list(genesis.validators) + [
+            _Val(bls.deterministic_keypair(16)[1].to_bytes()),
+            _Val(bls.deterministic_keypair(17)[1].to_bytes())]
+        table.sync(vals)
+        assert table.n == 18
+        x, _, inf = table.arrays()
+        inf = np.asarray(inf)
+        assert not inf[:18].any() and inf[18:].all()
+        # the already-synced prefix was NOT re-decompressed/moved
+        assert (np.asarray(x[:16]) == base).all()
+        assert (metrics.counter("pubkey_table_rows_synced").value
+                - synced0) == 2
+        assert metrics.gauge("pubkey_table_rows").value == 18
+        # steady state: zero rows transferred
+        synced1 = metrics.counter("pubkey_table_rows_synced").value
+        table.sync(vals)
+        assert metrics.counter("pubkey_table_rows_synced").value \
+            == synced1
+
+    def test_changed_rows_scatter_in_place(self, genesis):
+        vals = list(genesis.validators)
+        table = bls.PubkeyTable()
+        table.sync(vals)
+        new_pk = bls.deterministic_keypair(40)[1].to_bytes()
+        vals[3] = _Val(new_pk)
+        table.sync(vals, changed=[3])
+        assert table.n == 16
+        # row 3 now matches a from-scratch table over the same set
+        fresh = bls.PubkeyTable()
+        fresh.sync(vals)
+        for got, want in zip(table.arrays(), fresh.arrays()):
+            assert (np.asarray(got[:16]) == np.asarray(want[:16])).all()
+
+    def test_reset_rebuilds(self, genesis):
+        table = bls.PubkeyTable()
+        table.sync(genesis.validators)
+        table.reset()
+        assert table.n == 0 and table.nbytes() == 0
+        table.sync(genesis.validators)
+        assert table.n == 16
+        assert not np.asarray(table.arrays()[2][:16]).any()
+
+    def test_tail_reorg_triggers_rebuild(self, genesis):
+        table = bls.PubkeyTable()
+        table.sync(genesis.validators)
+        vals = list(genesis.validators)
+        # a fork with a DIFFERENT deposit tail at the same length
+        vals[15] = _Val(bls.deterministic_keypair(50)[1].to_bytes())
+        table.sync(vals)
+        assert table.n == 16
+        fresh = bls.PubkeyTable()
+        fresh.sync(vals)
+        assert (np.asarray(table.arrays()[0][:16])
+                == np.asarray(fresh.arrays()[0][:16])).all()
 
 
 class TestIndexedSlotPipeline:
@@ -202,6 +271,71 @@ class TestIndexedSlotPipeline:
 
         signers = set(get_beacon_committee(chain.head_state, 1, 0))
         assert signers <= voted
+
+
+class TestBucketPaddingSmoke:
+    """Stable-shape dispatch: one padded slot verify end-to-end on the
+    CPU backend, with the backend-compile counter installed — the
+    fast ``-m 'not slow'`` smoke for the recompile-free contract."""
+
+    def _batch_for(self, state, committees):
+        from prysm_tpu.operations.attestations import AttestationPool
+
+        pool = AttestationPool()
+        for ci in committees:
+            pool.save_aggregated(
+                testutil.valid_attestation(state, 1, ci))
+        return pool.build_slot_batch_indexed(state, 1)
+
+    def test_bucket_rounding(self):
+        assert bls._bucket(1) == 4 and bls._bucket(4) == 4
+        assert bls._bucket(5) == 8
+        assert bls._bucket(200) == 256
+
+    def test_device_args_are_bucket_padded(self, genesis):
+        b = self._batch_for(genesis, [0])
+        args = b.device_args()
+        idx, mask = args[3], args[4]
+        att_mask = args[12]
+        assert idx.shape[0] == 4 and mask.shape == idx.shape
+        assert idx.shape[1] == bls._bucket(idx.shape[1])
+        assert att_mask.shape == (4,)
+        assert list(np.asarray(att_mask)) == [True, False, False,
+                                              False]
+        # padded signature lanes parse as canonical infinity
+        sig_wf = np.asarray(args[8])
+        assert sig_wf.all()
+
+    def test_same_bucket_slots_compile_exactly_once(self, genesis):
+        """Two slots with DIFFERENT attestation counts inside one
+        bucket shape (A=1 and A=2, both padding to 4) must share one
+        compiled fused graph: the first may compile it, the second
+        compiles NOTHING."""
+        from prysm_tpu.crypto.bls.xla.verify import (
+            fused_slot_verify_device,
+        )
+        from prysm_tpu.monitoring.metrics import (
+            compile_guard, install_compile_counter,
+        )
+
+        install_compile_counter()
+        b1 = self._batch_for(genesis, [0])
+        b2 = self._batch_for(genesis, [0, 1])
+        assert len(b1) == 1 and len(b2) == 2
+        # identical padded shapes -> identical jit cache key
+        shapes1 = [getattr(a, "shape", None) for a in b1.device_args()]
+        shapes2 = [getattr(a, "shape", None) for a in b2.device_args()]
+        assert shapes1 == shapes2
+        before = fused_slot_verify_device._cache_size()
+        assert b1.verify()
+        after1 = fused_slot_verify_device._cache_size()
+        assert after1 - before <= 1       # at most the one bucket graph
+        assert b2.verify()
+        assert fused_slot_verify_device._cache_size() == after1
+        # steady state: ZERO backend compiles anywhere in the dispatch
+        with compile_guard(allowed=0) as guard:
+            assert b2.verify()
+        assert guard.hits == 0
 
 
 @pytest.mark.slow
