@@ -7,13 +7,23 @@
 
 PY ?= python
 
-.PHONY: test test-slow warm-cache dryrun bench native proto
+.PHONY: test test-slow chaos warm-cache dryrun bench native proto
 
 test:
 	$(PY) -m pytest tests/ -x -q
 
 test-slow:
 	$(PY) -m pytest tests/ -q -m slow
+
+# Chaos gate: the tier-1 suite under a SEEDED fault schedule (runtime/
+# faults.py) — every verdict must still match the golden model via the
+# degradation ladder — plus the chaos-marked tests without faults so
+# the ladder's own assertions (exact counters, breaker transitions)
+# run deterministically.
+chaos:
+	PRYSM_TPU_FAULTS="seed=1337;device_dispatch:rate=0.25" \
+		$(PY) -m pytest tests/ -x -q
+	$(PY) -m pytest tests/ -q -m chaos
 
 # Populate the fingerprint-keyed CPU compile cache on THIS host.
 # Per-file processes keep each run's compile count low enough that

@@ -518,7 +518,17 @@ def main() -> None:
         # a "{" line and would mistake an error blob for a result
         try:
             fn = dict((n, f) for n, f, _b in TIERS)[sys.argv[2]]
-            print(json.dumps(fn()))
+            result = fn()
+            # robustness provenance: whether this tier's numbers came
+            # from the fused device path or the degraded pure fallback
+            # (runtime/faults.py ladder) — a fallback-contaminated
+            # number must be distinguishable in BENCH_FULL.json
+            from prysm_tpu.monitoring.metrics import metrics as _m
+
+            result["degraded_dispatches"] = \
+                _m.counter("degraded_dispatches").value
+            result["breaker_trips"] = _m.counter("breaker_trips").value
+            print(json.dumps(result))
         except BaseException as e:   # noqa: BLE001 — child boundary
             print(f"# tier {sys.argv[2]} failed: {e!r}",
                   file=sys.stderr)

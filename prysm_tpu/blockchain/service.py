@@ -98,14 +98,31 @@ class BlockchainService:
                     process_slots(pre_state, block.slot, self.types)
                 from ..config import features
 
+                batch = None
                 if features().bls_implementation in ("xla", "pallas"):
                     # device-native: signer index rows into the
                     # service's persistent PubkeyTable; decompression
                     # + hash-to-curve + aggregate + pairing check fuse
                     # into ONE dispatch per block
-                    batch = collect_block_signature_batch_indexed(
-                        pre_state, signed_block, self.pubkey_table)
-                else:
+                    try:
+                        batch = collect_block_signature_batch_indexed(
+                            pre_state, signed_block, self.pubkey_table)
+                    except (ValueError, StateTransitionError):
+                        raise
+                    except Exception as fault:  # noqa: BLE001
+                        from ..runtime import faults as _faults
+
+                        if not _faults.is_transient(fault):
+                            raise
+                        # transient device fault while syncing/packing
+                        # the indexed batch (pubkey-table decompress,
+                        # device loss): degrade to the host object
+                        # path — receive_block must survive, a valid
+                        # block must not be rejected for a dead device
+                        from ..monitoring.metrics import metrics as _m
+
+                        _m.inc("degraded_dispatches")
+                if batch is None:
                     batch = collect_block_signature_batch(pre_state,
                                                           signed_block)
             except (ValueError, StateTransitionError) as e:

@@ -266,6 +266,33 @@ def pubkey_index_map(state) -> dict:
     return m
 
 
+def _note_registry_change(state, index: int) -> None:
+    """Record that validator ``index``'s registry row changed (append
+    or in-place pubkey replacement) so device pubkey tables can
+    re-sync exactly those rows (``PubkeyTable.sync(changed=...)``).
+    Stored in the state instance dict: ``copy()`` drops it, and a
+    fresh copy re-syncs by length/tail as before."""
+    state.__dict__.setdefault("_registry_changes", set()).add(int(index))
+
+
+def note_pubkey_replaced(state, index: int) -> None:
+    """Public hook for callers that replace an already-synced
+    validator's pubkey row in place (cross-fork state surgery,
+    tests): the next indexed batch built from ``state`` scatters
+    exactly that row into the device table."""
+    _note_registry_change(state, index)
+
+
+def pop_registry_changes(state) -> tuple:
+    """Drain ``state``'s changed-row set (consumed by the indexed
+    batch builders feeding ``PubkeyTable.sync(changed=...)``).  Pop
+    semantics: the first table synced against this state applies the
+    scatter; rows beyond a table's synced length are re-covered by
+    its own append path, so a second table misses nothing."""
+    changes = state.__dict__.pop("_registry_changes", None)
+    return tuple(sorted(changes)) if changes else ()
+
+
 def process_deposit(state, deposit) -> None:
     from ..proto import DEPOSIT_CONTRACT_TREE_DEPTH
 
@@ -310,6 +337,7 @@ def process_deposit(state, deposit) -> None:
             withdrawable_epoch=FAR_FUTURE_EPOCH,
         ))
         state.balances.append(amount)
+        _note_registry_change(state, len(state.validators) - 1)
     else:
         increase_balance(state, known[pubkey], amount)
 
@@ -438,7 +466,7 @@ def collect_block_signature_batch_indexed(state, signed_block, table):
     )
 
     cfg = beacon_config()
-    table.sync(state.validators)
+    table.sync(state.validators, changed=pop_registry_changes(state))
     block = signed_block.message
     rows, roots, sigs, descs = [], [], [], []
 

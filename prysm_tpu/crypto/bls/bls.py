@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ...config import features
+from ...runtime import faults as _faults
 from .params import ETH2_DST, R
 from .pure import signature as ps
 from .pure import curve as pc
@@ -231,6 +232,12 @@ class PubkeyTable:
         self._x = None            # jnp (cap, 24) Montgomery affine
         self._y = None
         self._inf = None          # jnp (cap,) bool; padding rows True
+        # host mirror of the synced rows' COMPRESSED pubkey bytes: the
+        # degraded (pure-backend) verify rung reconstructs per-signer
+        # PublicKey objects from these when the device table can't be
+        # gathered — without walking back to a state object the batch
+        # no longer holds
+        self._raw: list[bytes] = []
         # reorg sentinel: pubkey bytes of the last synced validator.
         # Registry appends are fork-local, so a head switch between
         # forks with different deposit tails can change index->pubkey
@@ -246,6 +253,7 @@ class PubkeyTable:
         """Batched decompress of ``pubs`` -> (X, Y, inf) device arrays
         trimmed to len(pubs) (the dispatch itself is bucket-padded so
         deposit batches of nearby sizes share one compiled graph)."""
+        _faults.fire("pubkey_sync")
         from .xla import limbs as L
         from .xla.compress import g1_decompress_batch
 
@@ -291,6 +299,8 @@ class PubkeyTable:
             self._x = self._x.at[rows].set(X)
             self._y = self._y.at[rows].set(Y)
             self._inf = self._inf.at[rows].set(inf)
+            for i in changed:
+                self._raw[i] = bytes(validators[i].pubkey)
             self._count_synced(len(changed), self.n)
         if n <= self.n:
             return
@@ -330,6 +340,7 @@ class PubkeyTable:
             self._y = self._y.at[sl].set(Y)
             self._inf = self._inf.at[sl].set(inf)
         self.n = n
+        self._raw.extend(pubs)
         self._tail = bytes(validators[n - 1].pubkey)
         self._count_synced(len(pubs), n)
 
@@ -338,6 +349,11 @@ class PubkeyTable:
 
         _m.inc("pubkey_table_rows_synced", rows)
         _m.set("pubkey_table_rows", total)
+
+    def raw_pubkey(self, i: int) -> bytes:
+        """Compressed pubkey bytes of synced row ``i`` (the degraded
+        verify rung's host-side gather)."""
+        return self._raw[i]
 
     def arrays(self):
         """(x, y, inf) device arrays, bucketed capacity."""
@@ -353,16 +369,35 @@ class PubkeyTable:
 def verify_multiple_signatures(batch: SignatureBatch, rng=None) -> bool:
     """Randomized-linear-combination batch verify (reference
     crypto/bls VerifyMultipleSignatures [U]): sound up to 2^-63 per
-    random scalar; a single tampered entry fails the whole check."""
+    random scalar; a single tampered entry fails the whole check.
+
+    Degradation: a transient device failure on the xla/pallas backend
+    falls back to the pure host backend (same RLC check, slower) and
+    feeds the fused-path circuit breaker — one flaky dispatch must
+    degrade throughput, not reject a valid batch."""
     if len(batch) == 0:
         return True
     if any(s.point is None for s in batch.signatures):
         return False
     if any(p.point is None for p in batch.public_keys):
         return False
-    return _backend().verify_multiple(
-        [s.point for s in batch.signatures], list(batch.messages),
-        [p.point for p in batch.public_keys], rng)
+    args = ([s.point for s in batch.signatures], list(batch.messages),
+            [p.point for p in batch.public_keys], rng)
+    backend = _backend()
+    if backend is _PureBackend:
+        return _PureBackend.verify_multiple(*args)
+    try:
+        ok = backend.verify_multiple(*args)
+        fused_breaker.record_success()
+        return ok
+    except Exception as e:              # noqa: BLE001 — classified below
+        if not _faults.is_transient(e):
+            raise
+        fused_breaker.record_failure()
+        from ...monitoring.metrics import metrics as _m
+
+        _m.inc("degraded_dispatches")
+        return _PureBackend.verify_multiple(*args)
 
 
 # --- backends --------------------------------------------------------------
@@ -495,14 +530,26 @@ class _PallasBackend(_XlaBackend):
 _BACKENDS = {"pure": _PureBackend, "xla": _XlaBackend,
              "pallas": _PallasBackend}
 
+# Circuit breaker guarding the fused/batched device path: trips open
+# after consecutive transient device failures; while open, every
+# verification caller resolves to the pure host backend (correct,
+# slower) and IndexedSlotBatch.verify probes the device path for
+# recovery every ``probe_every``-th attempt.
+fused_breaker = _faults.CircuitBreaker(trip_after=3, probe_every=8)
+
 
 def _backend():
-    name = features().bls_implementation
+    name = _faults.fire("backend_select", features().bls_implementation)
     try:
         backend = _BACKENDS[name]
     except KeyError:
         raise ValueError(f"unknown bls implementation {name!r}") from None
     if name in ("xla", "pallas"):
+        if fused_breaker.is_open():
+            # device path tripped open: single verifies and the
+            # per-attestation recovery loop must NOT re-dispatch onto
+            # the failing device; probing is the batch path's job
+            return _PureBackend
         from .xla import limbs as _L
 
         _L.set_mul_backend("pallas" if name == "pallas" else "xla")

@@ -82,6 +82,8 @@ class SlotDispatcher:
     def _drain_oldest(self) -> None:
         import numpy as np
 
+        from ....runtime import faults as _faults
+
         with self._lock:
             target = None
             for t, v in self._entries.items():
@@ -91,7 +93,13 @@ class SlotDispatcher:
             if target is None:
                 return
             tag, dev = self._entries[target]
-        resolved = bool(np.asarray(dev))
+        try:
+            resolved = bool(np.asarray(_faults.fire("readback", dev)))
+        except Exception as e:      # noqa: BLE001 — repropagated
+            # a failed buffer-bound readback belongs to the DRAINED
+            # ticket, not the submit that triggered the drain: store
+            # it so result(target) re-raises (or resubmit recovers it)
+            resolved = ("err", e)
         with self._lock:
             if self._entries.get(target, _ABANDONED) is not _ABANDONED:
                 self._entries[target] = resolved
@@ -101,8 +109,13 @@ class SlotDispatcher:
     def result(self, ticket: int) -> bool:
         """Verdict for ``ticket``.  Must be claimed in submission
         order; raises the work's exception if it failed, returns
-        False (fail-closed) if the dispatch was abandoned."""
+        False (fail-closed) if the dispatch was abandoned.  An
+        unknown ticket raises KeyError WITHOUT mutating the order
+        counter — the accounting for every later ticket survives a
+        caller's bookkeeping bug."""
         import numpy as np
+
+        from ....runtime import faults as _faults
 
         with self._lock:
             if ticket != self._next_result:
@@ -110,10 +123,10 @@ class SlotDispatcher:
                     f"results must be claimed in submission order "
                     f"(expected ticket {self._next_result}, "
                     f"got {ticket})")
-            entry = self._entries.pop(ticket, _PENDING)
+            if ticket not in self._entries:
+                raise KeyError(f"unknown ticket {ticket}")
+            entry = self._entries.pop(ticket)
             self._next_result += 1
-        if entry is _PENDING:
-            raise KeyError(f"unknown ticket {ticket}")
         if entry is _ABANDONED:
             return False                 # fail-closed
         if isinstance(entry, bool):
@@ -121,14 +134,57 @@ class SlotDispatcher:
         tag, payload = entry
         if tag == "err":
             raise payload
-        return bool(np.asarray(payload))
+        return bool(np.asarray(_faults.fire("readback", payload)))
+
+    def failed(self, ticket: int):
+        """Peek at ``ticket``'s captured exception (or None) WITHOUT
+        claiming the result — lets the producer decide to ``resubmit``
+        on a fallback backend before the consumer reaches it."""
+        with self._lock:
+            v = self._entries.get(ticket)
+        if isinstance(v, tuple) and v[0] == "err":
+            return v[1]
+        return None
+
+    def resubmit(self, ticket: int, work) -> bool:
+        """Re-run an unclaimed ticket's work in place (fault recovery:
+        the original dispatch failed, the caller re-dispatches on the
+        fallback backend).  Submission order is preserved — the ticket
+        keeps its slot, only its outcome is replaced.  Abandoned
+        tickets stay fail-closed and a closed dispatcher refuses;
+        returns True iff the new outcome was recorded."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("dispatcher is closed")
+            cur = self._entries.get(ticket, _PENDING)
+            if cur is _PENDING or cur is _ABANDONED:
+                return False
+        try:
+            value = ("ok", work())
+        except Exception as e:          # noqa: BLE001 — repropagated
+            value = ("err", e)
+        with self._lock:
+            cur = self._entries.get(ticket, _PENDING)
+            if cur is _PENDING or cur is _ABANDONED:
+                return False    # claimed or abandoned while re-running
+            self._entries[ticket] = value
+        from ....monitoring.metrics import metrics as _m
+
+        _m.inc("dispatch_resubmits")
+        return True
 
     def abandon(self, ticket: int) -> None:
         """Mark an in-flight dispatch abandoned: its ``result`` is
         False, its device value is never read back."""
         with self._lock:
-            if ticket in self._entries:
+            abandoned = (ticket in self._entries
+                         and self._entries[ticket] is not _ABANDONED)
+            if abandoned:
                 self._entries[ticket] = _ABANDONED
+        if abandoned:
+            from ....monitoring.metrics import metrics as _m
+
+            _m.inc("fail_closed_abandons")
 
     def pending(self) -> int:
         with self._lock:
@@ -139,5 +195,12 @@ class SlotDispatcher:
         fail-closed False) and refuse further submits."""
         with self._lock:
             self._closed = True
+            abandoned = 0
             for t in list(self._entries):
-                self._entries[t] = _ABANDONED
+                if self._entries[t] is not _ABANDONED:
+                    self._entries[t] = _ABANDONED
+                    abandoned += 1
+        if abandoned:
+            from ....monitoring.metrics import metrics as _m
+
+            _m.inc("fail_closed_abandons", abandoned)

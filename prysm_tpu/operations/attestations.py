@@ -15,6 +15,7 @@ verification per slot (``build_slot_signature_batch``).
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
 from dataclasses import dataclass
 
@@ -219,10 +220,13 @@ class AttestationPool:
         slot on the latency path."""
         import numpy as np
 
+        from ..core.transition import pop_registry_changes
+
         cfg = beacon_config()
         rows, roots, sigs, descs, atts = [], [], [], [], []
         with self._lock:
-            self.pubkey_table.sync(state.validators)
+            self.pubkey_table.sync(state.validators,
+                                   changed=pop_registry_changes(state))
             for committee, att in self._slot_entries(state, slot):
                 comm = np.asarray(committee, dtype=np.int32)
                 bits = np.asarray(att.aggregation_bits, dtype=bool)
@@ -329,6 +333,11 @@ class IndexedSlotBatch:
     # the attestation objects the batch covers, captured under the
     # pool lock — the ONLY list a verdict consumer may act on (TOCTOU)
     attestations: list
+    # set by verify() when the fused device path failed and the pure
+    # per-entry rung produced the verdicts: one bool per batch entry,
+    # in entry order.  Consumers (sync.verify_slot_batch) use these
+    # instead of re-dispatching each entry onto the failing device.
+    fallback_verdicts: list | None = None
 
     @staticmethod
     def empty() -> "IndexedSlotBatch":
@@ -386,7 +395,9 @@ class IndexedSlotBatch:
         from ..crypto.bls.xla.compress import parse_g2_compressed
         from ..crypto.bls.xla.h2c import hash_to_field_host
         from ..crypto.bls.xla.verify import random_rlc_bits
+        from ..runtime import faults as _faults
 
+        _faults.fire("h2c_pack")
         a = len(self.roots)
         ab = _bucket(a)
         inf_sig = bytes([0xC0]) + b"\x00" * 95
@@ -414,9 +425,11 @@ class IndexedSlotBatch:
         blocks).  The pool->verdict pipeline overlaps the next slot's
         host packing with this in-flight dispatch."""
         from ..crypto.bls.xla.verify import fused_slot_verify_device
+        from ..runtime import faults as _faults
 
         if len(self) == 0:
             return True
+        _faults.fire("device_dispatch")
         return fused_slot_verify_device(*self.device_args(rng))
 
     def verify(self, rng=None) -> bool:
@@ -424,7 +437,77 @@ class IndexedSlotBatch:
         hash-to-curve + registry gather/aggregate + RLC pairing check
         (fused_slot_verify_device).  Malformed signatures fail the
         whole batch in-graph (fail-closed; the caller's
-        per-attestation fallback isolates the culprit)."""
+        per-attestation fallback isolates the culprit).
+
+        Degradation ladder (a device fault degrades throughput, never
+        rejects valid votes):
+
+          1. fused device dispatch; a TRANSIENT failure (injected
+             fault, XLA runtime abort) retries once after a bounded
+             backoff — non-transient errors keep raising;
+          2. second failure feeds the circuit breaker and the batch
+             falls back to per-entry verification on the pure host
+             backend (``verify_each_pure``), stashing the individual
+             verdicts in ``fallback_verdicts``;
+          3. after ``trip_after`` consecutive failures the breaker
+             opens: subsequent batches skip the dead device entirely,
+             except a recovery probe every ``probe_every``-th call.
+        """
         import numpy as np
 
-        return bool(np.asarray(self.verify_async(rng)))
+        from ..crypto.bls.bls import fused_breaker
+        from ..monitoring.metrics import metrics as _m
+        from ..runtime import faults as _faults
+
+        if len(self) == 0:
+            return True
+        if fused_breaker.allow():
+            for attempt in (0, 1):
+                try:
+                    v = _faults.fire("readback", self.verify_async(rng))
+                    ok = bool(np.asarray(v))
+                except Exception as e:   # noqa: BLE001 — classified
+                    if not _faults.is_transient(e):
+                        raise            # malformed input: fail loudly
+                    if attempt == 0:
+                        _m.inc("fused_verify_retries")
+                        time.sleep(0.05)     # bounded backoff
+                        continue
+                    fused_breaker.record_failure()
+                    break
+                fused_breaker.record_success()
+                return ok
+        _m.inc("degraded_dispatches")
+        self.fallback_verdicts = self.verify_each_pure()
+        return all(self.fallback_verdicts)
+
+    def verify_each_pure(self) -> list:
+        """Per-entry host-golden-model verdicts (the degraded rung):
+        signer pubkey bytes come off the table's raw host mirror, the
+        check is the pure backend's fast-aggregate-verify.  Malformed
+        signature bytes or invalid/infinity pubkeys yield False for
+        THAT entry only — the same fail-closed verdict the fused
+        graph computes in-graph for its inf rows."""
+        import numpy as np
+
+        from ..crypto.bls import bls as _bls
+        from ..crypto.bls.params import ETH2_DST
+        from ..crypto.bls.pure import signature as ps
+
+        verdicts = []
+        for i in range(len(self.roots)):
+            rows = np.asarray(self.idx[i])[np.asarray(self.mask[i])]
+            try:
+                sig = _bls.Signature.from_bytes(self.sig_bytes[i])
+                pk_pts = [
+                    _pubkey_object(self.table.raw_pubkey(int(j))).point
+                    for j in rows]
+            except (ValueError, IndexError):
+                verdicts.append(False)
+                continue
+            if sig.point is None or not pk_pts:
+                verdicts.append(False)
+                continue
+            verdicts.append(bool(ps.fast_aggregate_verify_points(
+                pk_pts, self.roots[i], sig.point, ETH2_DST)))
+        return verdicts

@@ -1,0 +1,362 @@
+"""Fault injection + graceful-degradation runtime.
+
+A production consensus client cannot stall the chain because one TPU
+dispatch hiccuped.  The fused slot-verify pipeline (PR 1) is strictly
+fail-closed — any device abort rejects the whole attestation batch —
+so the recovery behavior around it (retry, pure-backend fallback,
+circuit breaking) must be PROVABLE under injected failure.  This
+module is both halves of that story:
+
+* **Chaos layer** — named injection points wired through the pipeline
+  seams.  A seeded :class:`FaultSchedule` decides deterministically,
+  per point and per call, whether to raise, delay, or corrupt.  With
+  no schedule installed, :func:`fire` is a None-check — zero overhead
+  on the hot path.
+
+  Injection points (the pipeline seams, host side of each dispatch):
+
+  ===================  ====================================================
+  ``device_dispatch``  the fused slot-verify jit dispatch
+                       (``IndexedSlotBatch.verify_async``)
+  ``readback``         host readback of a device verdict
+                       (``np.asarray`` in batch verify / SlotDispatcher)
+  ``pubkey_sync``      registry-table decompress dispatch
+                       (``PubkeyTable._decompress_rows``)
+  ``h2c_pack``         host hash-to-field packing
+                       (``IndexedSlotBatch.device_args``)
+  ``backend_select``   backend resolution (``bls._backend``)
+  ===================  ====================================================
+
+  Install via the ``PRYSM_TPU_FAULTS`` env var (read once at import)
+  or the :func:`inject` context manager (tests, bench)::
+
+      PRYSM_TPU_FAULTS="seed=1337;device_dispatch:rate=0.25;\\
+                        readback:rate=0.1,mode=delay,ms=20"
+
+      with faults.inject(device_dispatch=1.0):
+          batch.verify()        # fused path faults; pure fallback runs
+
+  Clause grammar: ``seed=N`` once, then per point
+  ``<point>[:key=val[,key=val...]]`` with keys ``rate`` (probability,
+  default 1.0), ``mode`` (``raise`` | ``delay`` | ``corrupt``, default
+  raise), ``ms`` (delay duration, default 10), ``first`` (fault only
+  the first N calls), ``after`` (start faulting at call N).  A bare
+  point name means rate=1.0, mode=raise.
+
+* **Degradation primitives** — :class:`CircuitBreaker` (trips the
+  fused path open after N consecutive transient failures, probes for
+  recovery every K denials) and :func:`is_transient` (the
+  retry/fallback eligibility test: injected faults and device-runtime
+  errors are transient; ValueError/TypeError from malformed input are
+  not — those must keep failing loudly).
+
+Every injected fault and every degradation transition increments a
+counter in ``monitoring.metrics`` so chaos runs are observable in the
+same ``/metrics`` text a production scrape sees.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+_POINTS = ("device_dispatch", "readback", "pubkey_sync", "h2c_pack",
+           "backend_select")
+
+
+class FaultError(RuntimeError):
+    """An injected fault (stands in for a transient device error)."""
+
+
+class _CorruptedReadback:
+    """corrupt-mode readback payload: surfaces as a transient error at
+    the moment the verdict is actually converted, like a torn DMA."""
+
+    def __bool__(self):
+        raise FaultError("injected corrupt readback")
+
+    def __array__(self, dtype=None, copy=None):
+        raise FaultError("injected corrupt readback")
+
+
+# corrupt-mode payload transforms per point; points without one raise
+_CORRUPTORS = {
+    "backend_select": lambda payload: "pure",
+    "readback": lambda payload: _CorruptedReadback(),
+}
+
+
+class _PointSpec:
+    __slots__ = ("rate", "mode", "ms", "first", "after")
+
+    def __init__(self, rate: float = 1.0, mode: str = "raise",
+                 ms: float = 10.0, first: int | None = None,
+                 after: int = 0):
+        if mode not in ("raise", "delay", "corrupt"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        self.rate = float(rate)
+        self.mode = mode
+        self.ms = float(ms)
+        self.first = None if first is None else int(first)
+        self.after = int(after)
+
+
+class FaultSchedule:
+    """Deterministic per-point fault decisions.
+
+    The decision for call ``k`` at point ``p`` is a pure function of
+    ``(seed, p, k)`` — independent of thread interleaving across
+    points, so a seeded chaos run is reproducible."""
+
+    def __init__(self, points: dict[str, _PointSpec], seed: int = 0):
+        for p in points:
+            if p not in _POINTS:
+                raise ValueError(
+                    f"unknown injection point {p!r} "
+                    f"(known: {', '.join(_POINTS)})")
+        self.seed = int(seed)
+        self.points = dict(points)
+        self._calls = {p: 0 for p in points}
+        self._lock = threading.Lock()
+
+    def _decide(self, point: str, k: int, spec: _PointSpec) -> bool:
+        if k < spec.after:
+            return False
+        if spec.first is not None and (k - spec.after) >= spec.first:
+            return False
+        if spec.rate >= 1.0:
+            return True
+        h = hashlib.sha256(
+            b"%d:%s:%d" % (self.seed, point.encode(), k)).digest()
+        return int.from_bytes(h[:8], "big") / 2.0**64 < spec.rate
+
+    def fire(self, point: str, payload=None):
+        spec = self.points.get(point)
+        if spec is None:
+            return payload
+        with self._lock:
+            k = self._calls[point]
+            self._calls[point] = k + 1
+        if not self._decide(point, k, spec):
+            return payload
+        from ..monitoring.metrics import metrics as _m
+
+        _m.inc("fault_injected_total")
+        _m.inc(f"fault_injected_{point}")
+        if spec.mode == "delay":
+            time.sleep(spec.ms / 1000.0)
+            return payload
+        if spec.mode == "corrupt":
+            corruptor = _CORRUPTORS.get(point)
+            if corruptor is not None:
+                return corruptor(payload)
+        raise FaultError(
+            f"injected fault at {point} (call {k}, seed {self.seed})")
+
+    def calls(self, point: str) -> int:
+        with self._lock:
+            return self._calls.get(point, 0)
+
+
+def parse_spec(spec: str) -> FaultSchedule:
+    """Parse the ``PRYSM_TPU_FAULTS`` schema (see module docstring)."""
+    seed = 0
+    points: dict[str, _PointSpec] = {}
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if clause.startswith("seed="):
+            seed = int(clause[5:])
+            continue
+        name, _, rest = clause.partition(":")
+        name = name.strip()
+        kwargs: dict = {}
+        if rest:
+            for kv in rest.split(","):
+                key, _, val = kv.partition("=")
+                key = key.strip()
+                if key in ("rate", "ms"):
+                    kwargs[key] = float(val)
+                elif key in ("first", "after"):
+                    kwargs[key] = int(val)
+                elif key == "mode":
+                    kwargs[key] = val.strip()
+                else:
+                    raise ValueError(
+                        f"unknown fault spec key {key!r} in {clause!r}")
+        points[name] = _PointSpec(**kwargs)
+    return FaultSchedule(points, seed=seed)
+
+
+_ACTIVE: FaultSchedule | None = None
+
+
+def _install_from_env() -> None:
+    global _ACTIVE
+    spec = os.environ.get("PRYSM_TPU_FAULTS")
+    if spec:
+        _ACTIVE = parse_spec(spec)
+
+
+_install_from_env()
+
+
+def fire(point: str, payload=None):
+    """The injection seam.  Disabled (the production default) this is
+    one None-check; with a schedule installed it may raise
+    :class:`FaultError`, sleep, or return a corrupted payload."""
+    sched = _ACTIVE
+    if sched is None:
+        return payload
+    return sched.fire(point, payload)
+
+
+def active() -> bool:
+    """True when a fault schedule is installed (tests asserting exact
+    compile/metric counts skip under chaos — counts are schedule-
+    dependent; verdict correctness is what chaos runs check)."""
+    return _ACTIVE is not None
+
+
+@contextmanager
+def inject(spec: str | FaultSchedule | None = None, seed: int = 0,
+           **points):
+    """Install a fault schedule for the duration of the block.
+
+    Accepts a spec string (env schema), a prebuilt schedule, or
+    per-point kwargs — a float is a rate, a dict is full spec keys::
+
+        with faults.inject(device_dispatch=1.0):
+            ...
+        with faults.inject(seed=7, readback={"rate": 0.5,
+                                             "mode": "delay", "ms": 5}):
+            ...
+    """
+    global _ACTIVE
+    if isinstance(spec, str):
+        sched = parse_spec(spec)
+    elif isinstance(spec, FaultSchedule):
+        sched = spec
+    else:
+        built = {}
+        for name, v in points.items():
+            built[name] = (_PointSpec(rate=float(v))
+                           if not isinstance(v, dict)
+                           else _PointSpec(**v))
+        sched = FaultSchedule(built, seed=seed)
+    previous = _ACTIVE
+    _ACTIVE = sched
+    try:
+        yield sched
+    finally:
+        _ACTIVE = previous
+
+
+# --- transient-error classification ----------------------------------------
+
+# Device-runtime error class names (jaxlib raises XlaRuntimeError for
+# aborts/OOM/timeouts; grpc-style names cover pjrt transport errors).
+_TRANSIENT_NAMES = frozenset({
+    "XlaRuntimeError", "InternalError", "DeadlineExceeded",
+    "ResourceExhausted", "UnavailableError", "AbortedError",
+})
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Retry/fallback eligibility: injected faults and device-runtime
+    errors degrade; malformed-input errors (ValueError/TypeError —
+    e.g. a garbage signature length) must keep raising so bad data is
+    never silently retried into the chain."""
+    if isinstance(exc, FaultError):
+        return True
+    if isinstance(exc, (ValueError, TypeError, AssertionError)):
+        return False
+    t = type(exc)
+    if t.__name__ in _TRANSIENT_NAMES:
+        return True
+    mod = t.__module__ or ""
+    return mod.startswith(("jaxlib", "jax."))
+
+
+# --- circuit breaker -------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Trip the fused device path open after ``trip_after`` CONSECUTIVE
+    transient failures; while open, :meth:`allow` denies (callers go
+    straight to the degraded path, sparing the dead device a doomed
+    multi-second dispatch) except every ``probe_every``-th denial,
+    which is a recovery probe.  A probe that succeeds closes the
+    breaker; one that fails keeps it open.
+
+    Transitions are counter-visible: ``breaker_trips``,
+    ``breaker_resets``, ``breaker_probes``, and the ``breaker_open``
+    gauge (0/1) all render through ``MetricsRegistry``."""
+
+    def __init__(self, trip_after: int = 3, probe_every: int = 8,
+                 name: str = "fused"):
+        assert trip_after >= 1 and probe_every >= 1
+        self.trip_after = trip_after
+        self.probe_every = probe_every
+        self.name = name
+        self._consecutive = 0
+        self._open = False
+        self._denied = 0
+        self._lock = threading.Lock()
+        # Register the transition counters at zero so the breaker's
+        # state is scrape-visible before the first trip/reset/probe.
+        m = self._metrics()
+        for c in ("breaker_trips", "breaker_resets", "breaker_probes"):
+            m.inc(c, 0)
+        m.set("breaker_open", 0)
+
+    def _metrics(self):
+        from ..monitoring.metrics import metrics
+
+        return metrics
+
+    def allow(self) -> bool:
+        with self._lock:
+            if not self._open:
+                return True
+            self._denied += 1
+            if self._denied % self.probe_every == 0:
+                self._metrics().inc("breaker_probes")
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            if self._open:
+                self._open = False
+                self._denied = 0
+                m = self._metrics()
+                m.inc("breaker_resets")
+                m.set("breaker_open", 0)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if not self._open and self._consecutive >= self.trip_after:
+                self._open = True
+                self._denied = 0
+                m = self._metrics()
+                m.inc("breaker_trips")
+                m.set("breaker_open", 1)
+
+    def is_open(self) -> bool:
+        with self._lock:
+            return self._open
+
+    def reset(self) -> None:
+        """Restore the pristine closed state (tests / manual ops)."""
+        with self._lock:
+            self._consecutive = 0
+            self._open = False
+            self._denied = 0
+        self._metrics().set("breaker_open", 0)

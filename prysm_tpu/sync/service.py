@@ -327,7 +327,21 @@ class SyncService:
             # device-native path: signer INDEX rows + the registry
             # pubkey table; aggregation happens on device inside the
             # verify dispatch — no pure-Python point math per slot
-            batch = self.att_pool.build_slot_batch_indexed(state, slot)
+            try:
+                batch = self.att_pool.build_slot_batch_indexed(
+                    state, slot)
+            except Exception as fault:  # noqa: BLE001
+                from ..runtime import faults as _faults
+
+                if not _faults.is_transient(fault):
+                    raise
+                # transient device fault syncing the pubkey table:
+                # degrade to the host object batch for this slot
+                from ..monitoring.metrics import metrics as _m
+
+                _m.inc("degraded_dispatches")
+                batch = self.att_pool.build_slot_signature_batch(
+                    state, slot)
         else:
             batch = self.att_pool.build_slot_signature_batch(state, slot)
         if len(batch) == 0:
@@ -349,13 +363,24 @@ class SyncService:
             return True
         if self.metrics is not None:
             self.metrics.inc("slot_batch_fallbacks")
+        # if the batch already degraded to the pure per-entry rung
+        # (device fault), it carries one host-golden-model verdict per
+        # attestation — consume those instead of re-dispatching each
+        # entry through is_valid_indexed_attestation onto a device
+        # that may be the thing that failed
+        fallback = getattr(batch, "fallback_verdicts", None)
+        if fallback is not None and len(fallback) != len(all_atts):
+            fallback = None
         any_bad = False
-        for att in all_atts:
-            try:
-                indexed = get_indexed_attestation(state, att)
-                valid = is_valid_indexed_attestation(state, indexed)
-            except Exception:
-                valid = False
+        for i, att in enumerate(all_atts):
+            if fallback is not None:
+                valid = bool(fallback[i])
+            else:
+                try:
+                    indexed = get_indexed_attestation(state, att)
+                    valid = is_valid_indexed_attestation(state, indexed)
+                except Exception:
+                    valid = False
             if valid:
                 self.chain.process_attestation_votes(state, att)
                 for observer in self.att_observers:

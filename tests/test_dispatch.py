@@ -82,3 +82,61 @@ def test_unknown_ticket_raises():
     d = SlotDispatcher()
     with pytest.raises(RuntimeError, match="submission order"):
         d.result(3)
+
+
+def test_unknown_in_order_ticket_does_not_desync():
+    """Regression: claiming ticket 0 before anything was submitted
+    used to raise KeyError AFTER advancing the order counter, so the
+    real ticket 0 (and every later one) became unclaimable."""
+    d = SlotDispatcher()
+    with pytest.raises(KeyError, match="unknown ticket"):
+        d.result(0)
+    t0 = d.submit(lambda: True)
+    assert t0 == 0
+    assert d.result(t0) is True       # counter was NOT desynced
+    t1 = d.submit(lambda: False)
+    assert d.result(t1) is False
+
+
+def test_failed_peeks_without_claiming():
+    d = SlotDispatcher()
+    err = ValueError("pack failed")
+
+    def boom():
+        raise err
+
+    t0 = d.submit(boom)
+    t1 = d.submit(lambda: True)
+    assert d.failed(t0) is err
+    assert d.failed(t1) is None
+    with pytest.raises(ValueError):    # peek did not claim
+        d.result(t0)
+    assert d.result(t1) is True
+
+
+def test_resubmit_replaces_failed_work_in_order():
+    """Fault-aware resubmit: a failed ticket re-dispatched (on the
+    fallback backend) before its result is claimed keeps its slot in
+    the submission order."""
+    d = SlotDispatcher()
+
+    def boom():
+        raise RuntimeError("device lost")
+
+    t0 = d.submit(boom)
+    t1 = d.submit(lambda: False)
+    assert d.failed(t0) is not None
+    assert d.resubmit(t0, lambda: True)
+    assert d.result(t0) is True        # recovered verdict, same slot
+    assert d.result(t1) is False
+
+
+def test_resubmit_refuses_abandoned_and_closed():
+    d = SlotDispatcher()
+    t0 = d.submit(lambda: True)
+    d.abandon(t0)
+    assert not d.resubmit(t0, lambda: True)
+    assert d.result(t0) is False       # abandoned stays fail-closed
+    d.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        d.resubmit(99, lambda: True)

@@ -1,0 +1,404 @@
+"""Chaos suite: fault injection + the graceful-degradation ladder.
+
+The acceptance contract (ISSUE 2): with ``device_dispatch`` faults
+injected at 100% rate, a full slot verify still returns the EXACT
+golden-model verdicts via the pure fallback — no valid attestation
+rejected, no invalid one accepted — and every degradation transition
+(retry, fallback, breaker trip/reset, fail-closed abandon) is visible
+as a counter in ``MetricsRegistry.render()``.
+
+Attestation counts stay tiny (1–2): every degraded verdict costs a
+pure-Python pairing (~seconds each).
+"""
+
+import numpy as np
+import pytest
+
+from prysm_tpu.config import (
+    set_features, use_mainnet_config, use_minimal_config,
+)
+from prysm_tpu.crypto.bls import bls
+from prysm_tpu.monitoring.metrics import metrics
+from prysm_tpu.proto import Attestation, build_types
+from prysm_tpu.runtime import faults
+from prysm_tpu.testing import util as testutil
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_xla():
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    yield
+    set_features(bls_implementation="pure")
+    use_mainnet_config()
+
+
+@pytest.fixture(scope="module")
+def types():
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    return build_types(MINIMAL_CONFIG)
+
+
+@pytest.fixture(scope="module")
+def genesis(types):
+    return testutil.deterministic_genesis_state(16, types)
+
+
+@pytest.fixture(autouse=True)
+def pristine_breaker():
+    bls.fused_breaker.reset()
+    yield
+    bls.fused_breaker.reset()
+
+
+def _counter(name: str) -> float:
+    return metrics.counter(name).value
+
+
+# --- schedule mechanics ------------------------------------------------------
+
+
+class TestFaultSchedule:
+    def test_env_schema_parses(self):
+        s = faults.parse_spec(
+            "seed=42;device_dispatch:rate=1.0;"
+            "readback:rate=0.5,mode=delay,ms=20;pubkey_sync:first=3;"
+            "h2c_pack:after=2,mode=corrupt;backend_select")
+        assert s.seed == 42
+        assert s.points["device_dispatch"].rate == 1.0
+        assert s.points["readback"].mode == "delay"
+        assert s.points["readback"].ms == 20.0
+        assert s.points["pubkey_sync"].first == 3
+        assert s.points["h2c_pack"].after == 2
+        # bare point name: rate 1.0, mode raise
+        assert s.points["backend_select"].rate == 1.0
+        assert s.points["backend_select"].mode == "raise"
+
+    def test_unknown_point_and_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown injection point"):
+            faults.parse_spec("warp_core:rate=1.0")
+        with pytest.raises(ValueError, match="unknown fault spec key"):
+            faults.parse_spec("readback:speed=9")
+        with pytest.raises(ValueError, match="unknown fault mode"):
+            faults.parse_spec("readback:mode=explode")
+
+    def test_seeded_decisions_are_deterministic(self):
+        def decisions(seed):
+            s = faults.parse_spec(f"seed={seed};readback:rate=0.5")
+            out = []
+            for _ in range(64):
+                try:
+                    s.fire("readback")
+                    out.append(False)
+                except faults.FaultError:
+                    out.append(True)
+            return out
+
+        a, b = decisions(7), decisions(7)
+        assert a == b                       # same seed: same schedule
+        assert 8 < sum(a) < 56              # rate is actually ~0.5
+        assert decisions(8) != a            # different seed differs
+
+    def test_first_and_after_windows(self):
+        with faults.inject(device_dispatch={"rate": 1.0, "first": 2,
+                                            "after": 1}) as s:
+            fired = []
+            for _ in range(5):
+                try:
+                    s.fire("device_dispatch")
+                    fired.append(False)
+                except faults.FaultError:
+                    fired.append(True)
+        assert fired == [False, True, True, False, False]
+
+    @pytest.mark.skipif(faults.active(),
+                        reason="an env fault schedule is installed")
+    def test_disabled_is_identity_passthrough(self):
+        assert not faults.active()
+        payload = object()
+        assert faults.fire("device_dispatch", payload) is payload
+
+    def test_inject_restores_previous_schedule(self):
+        prev = faults._ACTIVE
+        with faults.inject(readback=1.0):
+            assert faults.active()
+            with faults.inject(h2c_pack=1.0) as inner:
+                assert "readback" not in inner.points
+            assert set(faults._ACTIVE.points) == {"readback"}
+        assert faults._ACTIVE is prev
+
+    def test_corrupt_readback_raises_at_conversion(self):
+        with faults.inject(readback={"rate": 1.0, "mode": "corrupt"}):
+            v = faults.fire("readback", True)
+        with pytest.raises(faults.FaultError):
+            bool(v)
+
+    def test_injection_counters_render(self):
+        before = _counter("fault_injected_total")
+        with faults.inject(h2c_pack=1.0) as s:
+            with pytest.raises(faults.FaultError):
+                s.fire("h2c_pack")
+        assert _counter("fault_injected_total") == before + 1
+        assert "fault_injected_h2c_pack" in metrics.render()
+
+
+class TestTransientClassification:
+    def test_injected_and_device_errors_are_transient(self):
+        assert faults.is_transient(faults.FaultError("x"))
+
+        class XlaRuntimeError(Exception):
+            pass
+
+        assert faults.is_transient(XlaRuntimeError("device lost"))
+
+    def test_malformed_input_errors_are_not(self):
+        assert not faults.is_transient(ValueError("bad signature"))
+        assert not faults.is_transient(TypeError("bad arg"))
+
+
+# --- the degradation ladder --------------------------------------------------
+
+
+def _pool_with_atts(state, slot, committees):
+    from prysm_tpu.operations.attestations import AttestationPool
+
+    pool = AttestationPool()
+    for ci in committees:
+        pool.save_aggregated(testutil.valid_attestation(state, slot, ci))
+    return pool
+
+
+class TestDegradationLadder:
+    def test_full_fault_rate_returns_golden_verdicts_valid(self, genesis):
+        """Acceptance: 100% device_dispatch faults, all-valid slot —
+        the pure fallback must accept every attestation."""
+        pool = _pool_with_atts(genesis, 1, [0, 1])
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        degraded = _counter("degraded_dispatches")
+        with faults.inject(device_dispatch=1.0):
+            assert batch.verify() is True
+        assert batch.fallback_verdicts == [True, True]
+        assert _counter("degraded_dispatches") == degraded + 1
+        rendered = metrics.render()
+        assert "degraded_dispatches" in rendered
+        assert "breaker_trips" in rendered
+
+    def test_full_fault_rate_returns_golden_verdicts_mixed(self, genesis):
+        """Acceptance: the fallback must not ACCEPT the invalid entry
+        either — per-attestation verdicts match the golden model."""
+        pool = _pool_with_atts(genesis, 1, [1])
+        other = testutil.valid_attestation(genesis, 1, 1)
+        good = testutil.valid_attestation(genesis, 1, 0)
+        wrong = Attestation(aggregation_bits=good.aggregation_bits,
+                            data=good.data, signature=other.signature)
+        pool.save_aggregated(wrong)
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        assert len(batch) == 2
+        with faults.inject(device_dispatch=1.0):
+            assert batch.verify() is False
+        # per-entry verdicts match the golden model: the committee-1
+        # attestation is valid, the committee-0 one carries a stolen
+        # signature
+        want = [a.data.index == 1 for a in batch.attestations]
+        assert batch.fallback_verdicts == want
+
+    def test_malformed_signature_fails_closed_in_fallback(self, genesis):
+        pool = _pool_with_atts(genesis, 1, [0])
+        good = testutil.valid_attestation(genesis, 1, 1)
+        bad = Attestation(aggregation_bits=good.aggregation_bits,
+                          data=good.data, signature=b"\x13" * 96)
+        pool.save_aggregated(bad)
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        with faults.inject(device_dispatch=1.0):
+            assert batch.verify() is False
+        assert False in batch.fallback_verdicts
+        assert True in batch.fallback_verdicts
+
+    def test_transient_fault_retries_once_then_succeeds(
+            self, genesis, monkeypatch):
+        """first=1: only the first dispatch faults — the bounded-
+        backoff retry must recover WITHOUT degrading to pure."""
+        from prysm_tpu.crypto.bls.xla import verify as xverify
+
+        monkeypatch.setattr(xverify, "fused_slot_verify_device",
+                            lambda *a: True)
+        pool = _pool_with_atts(genesis, 1, [0])
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        retries = _counter("fused_verify_retries")
+        degraded = _counter("degraded_dispatches")
+        with faults.inject(device_dispatch={"rate": 1.0, "first": 1}):
+            assert batch.verify() is True
+        assert _counter("fused_verify_retries") == retries + 1
+        assert _counter("degraded_dispatches") == degraded
+        assert batch.fallback_verdicts is None
+        assert not bls.fused_breaker.is_open()
+
+    def test_non_transient_error_still_raises(self, genesis,
+                                              monkeypatch):
+        """Malformed input must fail loudly, never silently degrade."""
+        from prysm_tpu.crypto.bls.xla import verify as xverify
+
+        def bad_input(*a):
+            raise ValueError("garbage operand")
+
+        monkeypatch.setattr(xverify, "fused_slot_verify_device",
+                            bad_input)
+        pool = _pool_with_atts(genesis, 1, [0])
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        with pytest.raises(ValueError, match="garbage operand"):
+            batch.verify()
+
+    def test_breaker_trips_then_probes_then_recovers(
+            self, genesis, monkeypatch):
+        """After trip_after consecutive double-failures the breaker
+        opens (skipping the device entirely); once faults lift, the
+        probe_every-th call probes, succeeds, and closes it."""
+        from prysm_tpu.crypto.bls.xla import verify as xverify
+        from prysm_tpu.operations.attestations import IndexedSlotBatch
+
+        monkeypatch.setattr(xverify, "fused_slot_verify_device",
+                            lambda *a: True)
+        # the ladder's fallback rung is covered elsewhere; stub it so
+        # this test pays no pure pairings for its ~10 verifies
+        monkeypatch.setattr(IndexedSlotBatch, "verify_each_pure",
+                            lambda self: [True] * len(self))
+        breaker = faults.CircuitBreaker(trip_after=2, probe_every=3)
+        monkeypatch.setattr(bls, "fused_breaker", breaker)
+        pool = _pool_with_atts(genesis, 1, [0])
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        trips = _counter("breaker_trips")
+        resets = _counter("breaker_resets")
+        with faults.inject(device_dispatch=1.0):
+            assert batch.verify() is True    # fail+retry+fail -> pure
+            assert not breaker.is_open()
+            assert batch.verify() is True    # second consecutive
+            assert breaker.is_open()
+            # open: denials skip the (still-faulting) device, except
+            # the probe — which faults again and keeps it open
+            for _ in range(4):
+                assert batch.verify() is True
+            assert breaker.is_open()
+        assert _counter("breaker_trips") == trips + 1
+        assert metrics.gauge("breaker_open").value == 1
+        # faults lifted: denials until the next probe, which succeeds
+        # (empty inject shields this loop from any env fault schedule)
+        with faults.inject():
+            for _ in range(breaker.probe_every * 3):
+                batch.verify()
+                if not breaker.is_open():
+                    break
+        assert not breaker.is_open()
+        assert _counter("breaker_resets") == resets + 1
+        assert metrics.gauge("breaker_open").value == 0
+
+    def test_open_breaker_degrades_single_verifies_to_pure(self):
+        bls.fused_breaker.record_failure()
+        bls.fused_breaker.record_failure()
+        bls.fused_breaker.record_failure()
+        assert bls.fused_breaker.is_open()
+        assert bls._backend() is bls._PureBackend
+
+    def test_backend_select_corrupt_forces_pure(self):
+        with faults.inject(backend_select={"rate": 1.0,
+                                           "mode": "corrupt"}):
+            assert bls._backend() is bls._PureBackend
+
+
+# --- dispatcher under readback faults ---------------------------------------
+
+
+class TestDispatcherUnderFaults:
+    def test_result_readback_fault_propagates(self):
+        from prysm_tpu.crypto.bls.xla.dispatch import SlotDispatcher
+
+        d = SlotDispatcher()
+        t0 = d.submit(lambda: np.asarray(True))
+        with faults.inject(readback=1.0):
+            with pytest.raises(faults.FaultError):
+                d.result(t0)
+
+    def test_drain_readback_fault_lands_on_drained_ticket(self):
+        """A faulted buffer-bound readback must surface from the
+        DRAINED ticket's result — and be recoverable via resubmit —
+        not blow up the unrelated submit that triggered the drain."""
+        from prysm_tpu.crypto.bls.xla.dispatch import SlotDispatcher
+
+        d = SlotDispatcher(max_in_flight=1)
+        t0 = d.submit(lambda: np.asarray(True))
+        with faults.inject(readback=1.0):
+            t1 = d.submit(lambda: True)    # drains t0: readback faults
+        assert isinstance(d.failed(t0), faults.FaultError)
+        assert d.resubmit(t0, lambda: True)
+        assert d.result(t0) is True
+        assert d.result(t1) is True
+
+    def test_abandon_under_faults_is_fail_closed(self):
+        from prysm_tpu.crypto.bls.xla.dispatch import SlotDispatcher
+
+        d = SlotDispatcher()
+        abandons = _counter("fail_closed_abandons")
+        with faults.inject(readback=1.0):
+            t0 = d.submit(lambda: np.asarray(True))
+            d.abandon(t0)
+            assert d.result(t0) is False   # no readback ever attempted
+        assert _counter("fail_closed_abandons") == abandons + 1
+
+    def test_close_under_faults_is_fail_closed(self):
+        from prysm_tpu.crypto.bls.xla.dispatch import SlotDispatcher
+
+        d = SlotDispatcher()
+        abandons = _counter("fail_closed_abandons")
+        with faults.inject(readback=1.0):
+            t0 = d.submit(lambda: np.asarray(True))
+            t1 = d.submit(lambda: np.asarray(False))
+            d.close()
+            assert d.result(t0) is False
+            assert d.result(t1) is False
+        assert _counter("fail_closed_abandons") == abandons + 2
+
+
+# --- registry-change tracking (satellite) -----------------------------------
+
+
+class TestRegistryChangeTracking:
+    def test_deposit_append_notes_change(self, types):
+        from prysm_tpu.core import transition as tr
+
+        st = testutil.deterministic_genesis_state(16, types)
+        tr._note_registry_change(st, len(st.validators) - 1)
+        tr.note_pubkey_replaced(st, 3)
+        assert tr.pop_registry_changes(st) == (3, 15)
+        assert tr.pop_registry_changes(st) == ()   # drained
+
+    def test_copy_drops_pending_changes(self, types):
+        from prysm_tpu.core import transition as tr
+
+        st = testutil.deterministic_genesis_state(16, types)
+        tr.note_pubkey_replaced(st, 5)
+        assert tr.pop_registry_changes(st.copy()) == ()
+        assert tr.pop_registry_changes(st) == (5,)
+
+    def test_noted_replacement_scatters_into_pool_table(self, types):
+        """note_pubkey_replaced -> build_slot_batch_indexed re-syncs
+        exactly that row (a mid-registry in-place replacement is
+        invisible to the length/tail checks)."""
+        from prysm_tpu.core import transition as tr
+        from prysm_tpu.operations.attestations import AttestationPool
+
+        st = testutil.deterministic_genesis_state(16, types)
+        pool = AttestationPool()
+        pool.build_slot_batch_indexed(st, 1)       # initial sync
+        assert pool.pubkey_table.n == 16
+        new_pk = bls.deterministic_keypair(40)[1].to_bytes()
+        st.validators[3].pubkey = new_pk
+        tr.note_pubkey_replaced(st, 3)
+        pool.build_slot_batch_indexed(st, 1)       # scatters row 3
+        assert pool.pubkey_table.raw_pubkey(3) == new_pk
+        fresh = bls.PubkeyTable()
+        fresh.sync(st.validators)
+        got = np.asarray(pool.pubkey_table.arrays()[0][:16])
+        want = np.asarray(fresh.arrays()[0][:16])
+        assert (got == want).all()

@@ -317,7 +317,12 @@ class TestBucketPaddingSmoke:
         from prysm_tpu.monitoring.metrics import (
             compile_guard, install_compile_counter,
         )
+        from prysm_tpu.runtime import faults
 
+        if faults.active():
+            pytest.skip("compile-count assertions are not "
+                        "fault-deterministic: an injected dispatch "
+                        "fault skips the compile it counts on")
         install_compile_counter()
         b1 = self._batch_for(genesis, [0])
         b2 = self._batch_for(genesis, [0, 1])
