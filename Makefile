@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow chaos warm-cache dryrun bench native proto
+.PHONY: test test-slow chaos stream warm-cache dryrun bench native proto
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -24,6 +24,17 @@ chaos:
 	PRYSM_TPU_FAULTS="seed=1337;device_dispatch:rate=0.25" \
 		$(PY) -m pytest tests/ -x -q
 	$(PY) -m pytest tests/ -q -m chaos
+
+# Streaming-scheduler gate: the sched suite under a seeded fault
+# schedule (megabatch retry/bisect must still produce golden
+# verdicts), the same suite clean (exact flush/bisect/demotion
+# counters), then the stream_verify throughput tier (sustained
+# sigs/sec + amortized ms/slot at N∈{1,4,16}).
+stream:
+	PRYSM_TPU_FAULTS="seed=2026;device_dispatch:rate=0.25" \
+		$(PY) -m pytest tests/test_sched.py -x -q
+	$(PY) -m pytest tests/test_sched.py -x -q
+	PRYSM_TIER_BUDGET=2400 $(PY) bench.py --tier stream_verify
 
 # Populate the fingerprint-keyed CPU compile cache on THIS host.
 # Per-file processes keep each run's compile count low enough that

@@ -173,9 +173,10 @@ class SlotDispatcher:
         _m.inc("dispatch_resubmits")
         return True
 
-    def abandon(self, ticket: int) -> None:
+    def abandon(self, ticket: int) -> int:
         """Mark an in-flight dispatch abandoned: its ``result`` is
-        False, its device value is never read back."""
+        False, its device value is never read back.  Returns how many
+        abandons this call counted (0 or 1)."""
         with self._lock:
             abandoned = (ticket in self._entries
                          and self._entries[ticket] is not _ABANDONED)
@@ -185,14 +186,19 @@ class SlotDispatcher:
             from ....monitoring.metrics import metrics as _m
 
             _m.inc("fail_closed_abandons")
+        return 1 if abandoned else 0
 
     def pending(self) -> int:
         with self._lock:
             return len(self._entries)
 
-    def close(self) -> None:
+    def close(self) -> int:
         """Abandon every unclaimed dispatch (their results become
-        fail-closed False) and refuse further submits."""
+        fail-closed False) and refuse further submits.  Returns the
+        number of tickets abandoned — the dispatcher counts one
+        ``fail_closed_abandons`` per TICKET; a caller multiplexing
+        several slots onto one ticket (the megabatch scheduler) tops
+        the metric up to one per slot from this return value."""
         with self._lock:
             self._closed = True
             abandoned = 0
@@ -204,3 +210,4 @@ class SlotDispatcher:
             from ....monitoring.metrics import metrics as _m
 
             _m.inc("fail_closed_abandons", abandoned)
+        return abandoned

@@ -110,6 +110,9 @@ class BeaconNode:
 
     def stop(self) -> None:
         self.registry.stop_all()
+        # fail-closed: unclaimed scheduler work resolves False and is
+        # counted (fail_closed_abandons) before the db goes away
+        self.chain.close()
         self.db.close()
 
     # --- slot duties -------------------------------------------------------
@@ -119,6 +122,10 @@ class BeaconNode:
         previous slot's accumulated batch in ONE dispatch, prune."""
         cfg = beacon_config()
         self.metrics.set("current_slot", slot)
+        # linger deadline for the streaming scheduler: a partial
+        # megabatch never holds a verdict past linger_s just because
+        # traffic went thin
+        self.chain.scheduler.poll()
         self.sync.retry_pending()
         self.att_pool.aggregate_unaggregated()
         if slot >= 1:

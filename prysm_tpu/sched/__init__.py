@@ -1,0 +1,23 @@
+"""Streaming megabatch scheduler (the cross-slot batching subsystem).
+
+BREAKDOWN.json pins the single-slot fused dispatch to a ~93 ms
+dispatch-tunnel floor over ~63 ms of device compute, while a 16-slot
+batch already sustains 712k sigs/sec/chip (~18 ms/slot amortized,
+BENCH_FULL.json).  This package turns that batch rate into the
+steady-state production path: per-slot ``IndexedSlotBatch`` work
+accumulates into stable-shape megabatches of up to N slots
+(``megabatch.MegabatchAccumulator``), and a streaming pipeline
+(``stream.StreamScheduler``) overlaps host-side packing of the next
+megabatch with device compute of the current one on top of the
+double-buffered ``SlotDispatcher``.
+
+N is the latency/throughput knob: N=1 for head-of-chain (verdict
+latency identical to the fused per-slot path), N=16+ for initial
+sync, epoch replay, and backfill (amortizes the dispatch floor away).
+"""
+
+from .megabatch import (  # noqa: F401
+    FLUSH_CLOSE, FLUSH_DEMAND, FLUSH_FULL, FLUSH_LINGER,
+    FLUSH_TABLE_SWITCH, Megabatch, MegabatchAccumulator, join_batches,
+)
+from .stream import StreamScheduler  # noqa: F401

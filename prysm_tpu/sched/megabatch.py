@@ -1,0 +1,152 @@
+"""Cross-slot megabatch accumulation + flush policy.
+
+A megabatch is N slots' worth of ``IndexedSlotBatch`` work joined
+into ONE stable-shape (bucket-padded) fused device dispatch.  The
+accumulator owns WHEN that join happens; the policy is three explicit
+triggers, each of which is a metric:
+
+* **occupancy** — ``max_slots`` queued slots flush immediately
+  (``megabatch_flushes_full``).  ``max_slots`` is the scheduler's
+  latency/throughput knob: 1 keeps head-of-chain verdict latency at
+  the fused per-slot floor, 16+ amortizes the ~93 ms dispatch tunnel
+  across a sync/replay span.
+* **linger** — the OLDEST queued slot never waits longer than
+  ``linger_s`` before a partial megabatch flushes
+  (``megabatch_flushes_linger``): occupancy raises throughput,
+  linger bounds head-of-chain latency under thin traffic.
+* **demand / close** — a consumer blocking on a queued slot's verdict
+  flushes immediately (``megabatch_flushes_demand``); scheduler
+  shutdown fail-closes whatever is queued (``megabatch_flushes_close``
+  — see ``stream.StreamScheduler.close``).
+
+Joining never mutates the constituent batches: bisection (the
+degradation rung between a failed megabatch and per-attestation pure
+fallback) re-verifies the original per-slot batches, so they must
+survive the join intact.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+FLUSH_FULL = "full"
+FLUSH_LINGER = "linger"
+FLUSH_DEMAND = "demand"
+FLUSH_CLOSE = "close"
+FLUSH_TABLE_SWITCH = "table_switch"
+
+
+def _metrics():
+    from ..monitoring.metrics import metrics
+
+    return metrics
+
+
+def join_batches(batches):
+    """Join per-slot ``IndexedSlotBatch`` objects (same pubkey table)
+    into ONE fresh batch WITHOUT mutating any constituent —
+    ``IndexedSlotBatch.join`` widens/extends ``self`` in place, so the
+    first constituent is cloned before the fold.  The K axes re-pad to
+    the widest bucket (stable-shape dispatch)."""
+    from ..operations.attestations import IndexedSlotBatch
+
+    live = [b for b in batches if len(b) > 0]
+    if not live:
+        return IndexedSlotBatch.empty()
+    first = live[0]
+    out = IndexedSlotBatch(
+        idx=first.idx, mask=first.mask, roots=list(first.roots),
+        sig_bytes=list(first.sig_bytes),
+        descriptions=list(first.descriptions), table=first.table,
+        attestations=list(first.attestations))
+    for b in live[1:]:
+        out.join(b)
+    return out
+
+
+@dataclass
+class Megabatch:
+    """One flushed unit of cross-slot work: the (handle, batch) slots
+    it covers, their join, and the flush decision that produced it."""
+
+    entries: list          # [(handle:int, IndexedSlotBatch), ...]
+    joined: object         # IndexedSlotBatch (fresh; see join_batches)
+    reason: str
+    created_at: float = field(default_factory=time.monotonic)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def signatures(self) -> int:
+        return len(self.joined)
+
+
+class MegabatchAccumulator:
+    """Accumulate (handle, IndexedSlotBatch) slots and decide flushes.
+
+    Not thread-safe on its own — ``StreamScheduler`` serializes access
+    under its lock.  ``add`` may return up to two megabatches (a
+    table-switch flush of the old accumulation plus an occupancy flush
+    of the new slot); callers dispatch them in order."""
+
+    def __init__(self, max_slots: int = 1, linger_s: float = 0.25):
+        assert max_slots >= 1
+        self.max_slots = int(max_slots)
+        self.linger_s = float(linger_s)
+        self._pending: list = []     # [(handle, batch), ...]
+        self._oldest: float | None = None
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def pending_handles(self) -> list:
+        return [h for h, _b in self._pending]
+
+    def add(self, handle: int, batch, max_slots: int | None = None
+            ) -> list:
+        """Queue one slot's batch; returns the megabatches this add
+        flushed (possibly empty).  ``max_slots`` overrides the
+        configured knob for this call (breaker-open demotion to N=1
+        without losing the configured depth)."""
+        limit = self.max_slots if max_slots is None else max(
+            1, int(max_slots))
+        out = []
+        if self._pending and batch.table is not self._pending[0][1].table:
+            # megabatches join over ONE registry table; a different
+            # table starts a new accumulation (cross-service reuse,
+            # fork-local table rebuild)
+            mb = self.flush(FLUSH_TABLE_SWITCH)
+            if mb is not None:
+                out.append(mb)
+        if self._oldest is None:
+            self._oldest = time.monotonic()
+        self._pending.append((handle, batch))
+        if len(self._pending) >= limit:
+            mb = self.flush(FLUSH_FULL)
+            if mb is not None:
+                out.append(mb)
+        return out
+
+    def linger_expired(self) -> bool:
+        """True when the oldest queued slot has waited past the linger
+        deadline (the scheduler's ``poll`` flushes on this)."""
+        return (bool(self._pending) and self._oldest is not None
+                and time.monotonic() - self._oldest >= self.linger_s)
+
+    def flush(self, reason: str):
+        """Join everything queued into one ``Megabatch``; None when
+        nothing is pending.  Every flush is a metric: the reason
+        counter and the occupancy histogram."""
+        if not self._pending:
+            return None
+        entries, self._pending = self._pending, []
+        self._oldest = None
+        joined = join_batches([b for _h, b in entries])
+        m = _metrics()
+        m.inc(f"megabatch_flushes_{reason}")
+        m.observe("megabatch_occupancy", float(len(entries)))
+        m.inc("megabatch_slots_dispatched", len(entries))
+        return Megabatch(entries=entries, joined=joined, reason=reason)

@@ -1,0 +1,275 @@
+"""Streaming verify pipeline over the double-buffered SlotDispatcher.
+
+``StreamScheduler`` sits between the services (blockchain, sync,
+epoch replay) and the fused verify path.  Producers ``submit`` one
+``IndexedSlotBatch`` per slot/block and get a handle; the scheduler
+accumulates slots into megabatches (``megabatch.MegabatchAccumulator``)
+and dispatches each megabatch as ONE ticket on the double-buffered
+``SlotDispatcher`` — so host-side packing of megabatch k+1 overlaps
+device compute of megabatch k.  ``result(handle)`` drains tickets in
+submission order and demuxes per-slot verdicts.
+
+Degradation ladder (composes with PR 2's per-batch ladder, one rung
+higher):
+
+1. the fused megabatch dispatch; a TRANSIENT failure retries the
+   whole megabatch once (``megabatch_retries``, via the dispatcher's
+   order-preserving ``resubmit``);
+2. a megabatch that still fails — or verifies False with more than
+   one slot aboard — BISECTS into its constituent per-slot batches
+   (``megabatch_bisects``): each slot re-verifies through its own
+   PR-2 ladder (fused -> bounded retry -> per-attestation pure
+   fallback), so one poisoned slot costs one slot's fallback, never
+   the megabatch's;
+3. while the fused circuit breaker is open the scheduler demotes to
+   N=1 (``megabatch_demotions``) and routes each slot through
+   ``IndexedSlotBatch.verify`` directly — the breaker's allow/probe
+   machinery governs device recovery, exactly as in the per-slot path.
+
+Fail-closed shutdown: ``close()`` resolves every queued-but-
+undispatched slot AND every in-flight slot to a False verdict and
+counts each into ``fail_closed_abandons`` — a scheduler torn down
+mid-stream must never leave a slot's verdict implicitly "assumed
+verified" (or silently dropped with a dangling handle).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..runtime import faults as _faults
+from .megabatch import (
+    FLUSH_CLOSE, FLUSH_DEMAND, FLUSH_LINGER, MegabatchAccumulator,
+)
+
+
+def _metrics():
+    from ..monitoring.metrics import metrics
+
+    return metrics
+
+
+def _breaker():
+    from ..crypto.bls.bls import fused_breaker
+
+    return fused_breaker
+
+
+class StreamScheduler:
+    """Cross-slot streaming scheduler; see module docstring.
+
+    ``max_slots`` is the latency/throughput knob (N); ``linger_s``
+    bounds how long a partial megabatch may hold the oldest slot's
+    verdict back.  One scheduler serves batches over ONE registry
+    pubkey table at a time (a table switch flushes the accumulation).
+    """
+
+    def __init__(self, max_slots: int = 1, linger_s: float = 0.25,
+                 max_in_flight: int = 2, rng=None):
+        from ..crypto.bls.xla.dispatch import SlotDispatcher
+
+        self._acc = MegabatchAccumulator(max_slots=max_slots,
+                                         linger_s=linger_s)
+        self._disp = SlotDispatcher(max_in_flight=max_in_flight)
+        self._rng = rng
+        self._lock = threading.RLock()
+        self._next_handle = 0
+        # handle -> bool verdict | Exception (re-raised at claim)
+        self._verdicts: dict[int, object] = {}
+        self._inflight: deque = deque()   # (ticket, Megabatch)
+        self._closed = False
+
+    # --- knobs --------------------------------------------------------------
+
+    @property
+    def max_slots(self) -> int:
+        return self._acc.max_slots
+
+    def set_depth(self, n: int) -> None:
+        """Retarget the occupancy knob (N): callers raise it entering
+        a sync/replay span and drop it back to 1 at head-of-chain."""
+        with self._lock:
+            self._acc.max_slots = max(1, int(n))
+
+    # --- producer side ------------------------------------------------------
+
+    def submit(self, batch) -> int:
+        """Queue one slot's ``IndexedSlotBatch``; returns the handle to
+        pass to ``result``.  An empty batch verifies trivially True.
+        May dispatch (occupancy/table-switch flush) before returning."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            handle = self._next_handle
+            self._next_handle += 1
+            if len(batch) == 0:
+                self._verdicts[handle] = True
+                return handle
+            limit = 1 if _breaker().is_open() else None
+            for mb in self._acc.add(handle, batch, max_slots=limit):
+                self._dispatch(mb)
+            return handle
+
+    def poll(self) -> None:
+        """Flush a partial megabatch whose oldest slot outwaited the
+        linger deadline (called from the node's slot tick)."""
+        with self._lock:
+            if self._acc.linger_expired():
+                self._flush(FLUSH_LINGER)
+
+    def flush(self, reason: str = FLUSH_DEMAND) -> None:
+        """Dispatch whatever is accumulated now."""
+        with self._lock:
+            self._flush(reason)
+
+    def _flush(self, reason: str) -> None:
+        mb = self._acc.flush(reason)
+        if mb is not None:
+            self._dispatch(mb)
+
+    def _dispatch(self, mb) -> None:
+        if _breaker().is_open():
+            # demoted: the breaker's allow/probe cycle inside each
+            # slot's own ladder governs recovery — never aim a fused
+            # megabatch at a device the breaker already declared dead
+            _metrics().inc("megabatch_demotions")
+            self._settle_by_slot(mb)
+            return
+        _metrics().inc("megabatch_dispatches")
+        joined = mb.joined
+        rng = self._rng
+        ticket = self._disp.submit(lambda: joined.verify_async(rng))
+        self._inflight.append((ticket, mb))
+
+    # --- consumer side ------------------------------------------------------
+
+    def result(self, handle: int) -> bool:
+        """Verdict for ``handle`` (blocks).  Forces a demand flush if
+        the handle is still accumulating; drains megabatch tickets in
+        dispatch order until the handle's verdict is demuxed.  Raises
+        the slot's captured non-transient exception, KeyError for an
+        unknown/already-claimed handle."""
+        with self._lock:
+            while handle not in self._verdicts:
+                if handle in self._acc.pending_handles():
+                    self._flush(FLUSH_DEMAND)
+                elif self._inflight:
+                    self._drain_one()
+                else:
+                    raise KeyError(
+                        f"unknown or already-claimed handle {handle}")
+            v = self._verdicts.pop(handle)
+        if isinstance(v, BaseException):
+            raise v
+        return bool(v)
+
+    def verify_now(self, batch) -> bool:
+        """Submit + claim in one call — the synchronous entry the
+        per-slot services use.  At N=1 this is the passthrough path:
+        one fused dispatch, verdict semantics identical to
+        ``IndexedSlotBatch.verify``."""
+        return self.result(self.submit(batch))
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._acc) + sum(
+                len(mb) for _t, mb in self._inflight)
+
+    # --- drain / degradation ------------------------------------------------
+
+    def _drain_one(self) -> None:
+        ticket, mb = self._inflight.popleft()
+        m = _metrics()
+        err = self._disp.failed(ticket)
+        if err is not None and _faults.is_transient(err):
+            # rung 1: one bounded whole-megabatch retry, same ticket
+            # (order-preserving resubmit)
+            m.inc("megabatch_retries")
+            joined, rng = mb.joined, self._rng
+            self._disp.resubmit(ticket,
+                                lambda: joined.verify_async(rng))
+        try:
+            ok = self._disp.result(ticket)
+        except Exception as e:      # noqa: BLE001 — classified below
+            if _faults.is_transient(e):
+                # rung 2: still faulting after the retry — feed the
+                # breaker, bisect into per-slot ladders
+                _breaker().record_failure()
+                self._settle_by_slot(mb, bisected=True)
+            else:
+                # malformed input somewhere in the joined pack: the
+                # bisection isolates the culprit slot — only ITS claim
+                # re-raises; innocent slots still get real verdicts
+                self._settle_by_slot(mb, bisected=True)
+            self._observe_amortized(mb)
+            return
+        if ok:
+            _breaker().record_success()
+            for h, _b in mb.entries:
+                self._verdicts[h] = True
+        elif len(mb.entries) == 1:
+            # a clean single-slot False is a VERDICT, not a fault:
+            # the consumer's own per-attestation recovery takes over
+            # (identical to the fused per-slot path's semantics)
+            _breaker().record_success()
+            self._verdicts[mb.entries[0][0]] = False
+        else:
+            # the RLC check rejected the megabatch: some slot is
+            # poisoned — bisect to isolate it instead of collapsing
+            # everything to per-attestation fallback
+            _breaker().record_success()
+            self._settle_by_slot(mb, bisected=True)
+        self._observe_amortized(mb)
+
+    def _settle_by_slot(self, mb, bisected: bool = False) -> None:
+        """Re-verify each constituent slot batch through its OWN PR-2
+        ladder (fused -> bounded retry -> per-attestation pure
+        fallback; breaker-gated).  Side effects land on the original
+        batch objects (``fallback_verdicts``), so consumers holding
+        them see the degraded per-entry verdicts as before."""
+        if bisected:
+            _metrics().inc("megabatch_bisects")
+        for h, b in mb.entries:
+            try:
+                self._verdicts[h] = b.verify(self._rng)
+            except Exception as e:   # noqa: BLE001 — re-raised at claim
+                self._verdicts[h] = e
+
+    def _observe_amortized(self, mb) -> None:
+        _metrics().observe(
+            "megabatch_amortized_slot_seconds",
+            (time.monotonic() - mb.created_at) / max(1, len(mb)))
+
+    # --- shutdown -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Fail-closed shutdown: every queued-but-undispatched slot
+        and every in-flight slot resolves to a False verdict, each
+        counted into ``fail_closed_abandons`` (the dispatcher counts
+        one abandon per TICKET; the scheduler tops that up to one per
+        SLOT so the accounting matches what was actually dropped).
+        Already-claimable verdicts stay claimable."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            m = _metrics()
+            mb = self._acc.flush(FLUSH_CLOSE)
+            if mb is not None:
+                for h, _b in mb.entries:
+                    self._verdicts[h] = False
+                m.inc("fail_closed_abandons", len(mb.entries))
+            inflight_slots = 0
+            for _ticket, inflight_mb in self._inflight:
+                for h, _b in inflight_mb.entries:
+                    self._verdicts[h] = False
+                inflight_slots += len(inflight_mb.entries)
+            self._inflight.clear()
+            # the dispatcher counts one abandon per TICKET it actually
+            # fail-closes; top up to one per SLOT riding those tickets
+            ticket_abandons = self._disp.close()
+            if inflight_slots > ticket_abandons:
+                m.inc("fail_closed_abandons",
+                      inflight_slots - ticket_abandons)

@@ -323,6 +323,7 @@ class SyncService:
         state = self.chain.head_state
         from ..config import features
 
+        indexed = False
         if features().bls_implementation in ("xla", "pallas"):
             # device-native path: signer INDEX rows + the registry
             # pubkey table; aggregation happens on device inside the
@@ -330,6 +331,7 @@ class SyncService:
             try:
                 batch = self.att_pool.build_slot_batch_indexed(
                     state, slot)
+                indexed = True
             except Exception as fault:  # noqa: BLE001
                 from ..runtime import faults as _faults
 
@@ -346,7 +348,13 @@ class SyncService:
             batch = self.att_pool.build_slot_signature_batch(state, slot)
         if len(batch) == 0:
             return True
-        ok = batch.verify()
+        # indexed slot batches ride the chain's streaming scheduler:
+        # at N=1 a passthrough fused dispatch; at sync depth this
+        # slot's work joins the in-progress megabatch.  Bisection on a
+        # failed megabatch re-verifies THIS batch object, so the
+        # fallback_verdicts consumption below is unchanged.
+        ok = (self.chain.scheduler.verify_now(batch) if indexed
+              else batch.verify())
         if self.metrics is not None:
             self.metrics.inc("slot_batch_signatures", len(batch))
         # only the batch's OWN entries (captured under the pool lock

@@ -1,0 +1,416 @@
+"""Streaming megabatch scheduler suite (ISSUE 6).
+
+Acceptance contract: N=1 submits return verdicts identical to the
+per-slot verify path; the flush policy (occupancy / linger / demand /
+close) is observable through its metrics; a poisoned slot inside a
+megabatch is isolated by bisection — under a 100% device fault rate
+the golden per-attestation verdicts still come back (chaos marker);
+and an open circuit breaker demotes the scheduler to N=1 without
+losing verdicts.
+
+Like the ladder tests in test_faults.py, this suite never dispatches
+the real fused XLA graph: ``verify_async`` is replaced module-wide by
+a stand-in that keeps the dispatch seams (empty-batch shortcut, the
+``device_dispatch`` fault point) but computes the batch verdict on the
+pure golden model.  Compiling — or AOT-cache-loading, which recompiles
+on XLA:CPU — ``fused_slot_verify_device`` takes many minutes on a
+small CI host, and the scheduler's contract (join/demux, flush policy,
+bisection, demotion, fail-closed close) is independent of which
+backend produced the verdict.  The real-dispatch contract is carried
+by tests/test_indexed_slot.py and the stream_verify bench tier.
+
+Attestation counts stay tiny: every pure verdict costs a pure-Python
+pairing (~seconds each).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from prysm_tpu.config import (
+    set_features, use_mainnet_config, use_minimal_config,
+)
+from prysm_tpu.crypto.bls import bls
+from prysm_tpu.monitoring.metrics import metrics
+from prysm_tpu.proto import Attestation, build_types
+from prysm_tpu.runtime import faults
+from prysm_tpu.sched import (
+    FLUSH_FULL, MegabatchAccumulator, StreamScheduler, join_batches,
+)
+from prysm_tpu.testing import util as testutil
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_xla():
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    yield
+    set_features(bls_implementation="pure")
+    use_mainnet_config()
+
+
+@pytest.fixture(scope="module")
+def types():
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    return build_types(MINIMAL_CONFIG)
+
+
+@pytest.fixture(scope="module")
+def genesis(types):
+    return testutil.deterministic_genesis_state(16, types)
+
+
+def _pure_verify_async(self, rng=None):
+    """Fused-dispatch stand-in: same seams, golden-model verdict.
+
+    Mirrors ``IndexedSlotBatch.verify_async`` — the empty shortcut and
+    the ``device_dispatch`` injection point fire exactly as on the
+    device path, so the ladder (retry / bisect / breaker) sees the
+    same behavior — but the verdict is ``all(verify_each_pure())``,
+    the same fail-closed RLC semantics without the fused XLA graph.
+    ``fallback_verdicts`` is deliberately NOT set: only the degraded
+    pure rung of ``verify()`` stashes per-entry verdicts.
+    """
+    from prysm_tpu.runtime import faults as _faults
+
+    if len(self) == 0:
+        return True
+    _faults.fire("device_dispatch")
+    return np.asarray(all(self.verify_each_pure()))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def pure_fused(minimal_xla):
+    from prysm_tpu.operations.attestations import IndexedSlotBatch
+
+    mp = pytest.MonkeyPatch()
+    mp.setattr(IndexedSlotBatch, "verify_async", _pure_verify_async)
+    yield
+    mp.undo()
+
+
+@pytest.fixture(autouse=True)
+def pristine_breaker():
+    bls.fused_breaker.reset()
+    yield
+    bls.fused_breaker.reset()
+
+
+def _counter(name: str) -> float:
+    return metrics.counter(name).value
+
+
+def _pool_with_atts(state, slot, committees):
+    from prysm_tpu.operations.attestations import AttestationPool
+
+    pool = AttestationPool()
+    for ci in committees:
+        pool.save_aggregated(testutil.valid_attestation(state, slot, ci))
+    return pool
+
+
+def _poisoned_pool(state, slot):
+    """One valid attestation + one carrying a stolen signature."""
+    pool = _pool_with_atts(state, slot, [1])
+    other = testutil.valid_attestation(state, slot, 1)
+    good = testutil.valid_attestation(state, slot, 0)
+    wrong = Attestation(aggregation_bits=good.aggregation_bits,
+                        data=good.data, signature=other.signature)
+    pool.save_aggregated(wrong)
+    return pool
+
+
+# --- megabatch join / accumulator (no faults) --------------------------------
+
+
+class TestJoinBatches:
+    def test_join_does_not_mutate_constituents(self, genesis):
+        pools = [_pool_with_atts(genesis, s, [0]) for s in (1, 2)]
+        table = pools[0].pubkey_table
+        pools[1].pubkey_table = table   # one registry table
+        a = pools[0].build_slot_batch_indexed(genesis, 1)
+        b = pools[1].build_slot_batch_indexed(genesis, 2)
+        la, lb = len(a), len(b)
+        joined = join_batches([a, b])
+        assert len(joined) == la + lb
+        assert len(a) == la and len(b) == lb   # originals intact
+        assert joined is not a and joined is not b
+        # constituents still independently verifiable (bisection)
+        assert a.verify() is True
+        assert b.verify() is True
+
+    def test_empty_constituents_are_dropped(self, genesis):
+        from prysm_tpu.operations.attestations import IndexedSlotBatch
+
+        pool = _pool_with_atts(genesis, 1, [0])
+        a = pool.build_slot_batch_indexed(genesis, 1)
+        joined = join_batches([IndexedSlotBatch.empty(), a])
+        assert len(joined) == len(a)
+        assert len(join_batches([IndexedSlotBatch.empty()])) == 0
+
+
+class TestAccumulatorPolicy:
+    def test_occupancy_flush_at_max_slots(self, genesis):
+        pool = _pool_with_atts(genesis, 1, [0, 1])
+        b = pool.build_slot_batch_indexed(genesis, 1)
+        acc = MegabatchAccumulator(max_slots=2, linger_s=60)
+        assert acc.add(0, b) == []
+        flushed = acc.add(1, b)
+        assert len(flushed) == 1
+        assert flushed[0].reason == FLUSH_FULL
+        assert len(flushed[0]) == 2
+        assert len(acc) == 0
+
+    def test_linger_deadline(self, genesis):
+        pool = _pool_with_atts(genesis, 1, [0])
+        b = pool.build_slot_batch_indexed(genesis, 1)
+        acc = MegabatchAccumulator(max_slots=8, linger_s=0.01)
+        assert not acc.linger_expired()
+        acc.add(0, b)
+        time.sleep(0.02)
+        assert acc.linger_expired()
+        mb = acc.flush("linger")
+        assert len(mb) == 1
+        assert not acc.linger_expired()   # empty again
+
+    def test_table_switch_flushes_old_accumulation(self, genesis):
+        pool_a = _pool_with_atts(genesis, 1, [0])
+        pool_b = _pool_with_atts(genesis, 1, [1])
+        a = pool_a.build_slot_batch_indexed(genesis, 1)
+        b = pool_b.build_slot_batch_indexed(genesis, 1)
+        assert a.table is not b.table
+        acc = MegabatchAccumulator(max_slots=8, linger_s=60)
+        acc.add(0, a)
+        switches = _counter("megabatch_flushes_table_switch")
+        flushed = acc.add(1, b)
+        assert len(flushed) == 1 and len(flushed[0]) == 1
+        assert flushed[0].entries[0][0] == 0
+        assert _counter("megabatch_flushes_table_switch") == switches + 1
+        assert acc.pending_handles() == [1]
+
+
+# --- scheduler happy paths ---------------------------------------------------
+
+
+class TestSchedulerVerdicts:
+    def test_n1_passthrough_matches_fused_path(self, genesis):
+        """N=1: the scheduler verdict equals the direct per-slot
+        verdict, for a valid slot and for a poisoned one."""
+        sched = StreamScheduler(max_slots=1)
+        pool = _pool_with_atts(genesis, 1, [0])
+        direct = pool.build_slot_batch_indexed(genesis, 1).verify()
+        routed = sched.verify_now(
+            pool.build_slot_batch_indexed(genesis, 1))
+        assert routed is direct is True
+
+        bad_pool = _poisoned_pool(genesis, 1)
+        direct_bad = bad_pool.build_slot_batch_indexed(
+            genesis, 1).verify()
+        routed_bad = sched.verify_now(
+            bad_pool.build_slot_batch_indexed(genesis, 1))
+        assert routed_bad is direct_bad is False
+
+    def test_occupancy_flush_one_ticket_demuxes_verdicts(self, genesis):
+        pool = _pool_with_atts(genesis, 1, [0])
+        pool2 = _pool_with_atts(genesis, 2, [1])
+        pool2.pubkey_table = pool.pubkey_table
+        sched = StreamScheduler(max_slots=2, linger_s=60)
+        full = _counter("megabatch_flushes_full")
+        dispatches = _counter("megabatch_dispatches")
+        h1 = sched.submit(pool.build_slot_batch_indexed(genesis, 1))
+        h2 = sched.submit(pool2.build_slot_batch_indexed(genesis, 2))
+        assert _counter("megabatch_flushes_full") == full + 1
+        # TWO slots, ONE dispatch
+        assert _counter("megabatch_dispatches") == dispatches + 1
+        assert sched.result(h1) is True
+        assert sched.result(h2) is True
+
+    def test_demand_flush_on_result(self, genesis):
+        pool = _pool_with_atts(genesis, 1, [0])
+        sched = StreamScheduler(max_slots=8, linger_s=60)
+        demand = _counter("megabatch_flushes_demand")
+        h = sched.submit(pool.build_slot_batch_indexed(genesis, 1))
+        assert sched.result(h) is True
+        assert _counter("megabatch_flushes_demand") == demand + 1
+
+    def test_linger_flush_via_poll(self, genesis):
+        pool = _pool_with_atts(genesis, 1, [0])
+        sched = StreamScheduler(max_slots=8, linger_s=0.01)
+        linger = _counter("megabatch_flushes_linger")
+        h = sched.submit(pool.build_slot_batch_indexed(genesis, 1))
+        time.sleep(0.02)
+        sched.poll()
+        assert _counter("megabatch_flushes_linger") == linger + 1
+        assert sched.result(h) is True
+
+    def test_empty_batch_is_trivially_true(self, genesis):
+        from prysm_tpu.operations.attestations import IndexedSlotBatch
+
+        sched = StreamScheduler(max_slots=4)
+        dispatches = _counter("megabatch_dispatches")
+        h = sched.submit(IndexedSlotBatch.empty())
+        assert sched.result(h) is True
+        assert _counter("megabatch_dispatches") == dispatches
+
+    def test_unknown_handle_raises(self, genesis):
+        sched = StreamScheduler(max_slots=1)
+        with pytest.raises(KeyError):
+            sched.result(99)
+
+
+# --- bisection / degradation -------------------------------------------------
+
+
+class TestBisection:
+    def test_clean_false_megabatch_bisects_to_isolate_slot(
+            self, genesis):
+        """No faults at all: the fused megabatch verdict is False
+        because ONE slot is poisoned — bisection pins the False on
+        that slot, the innocent slot still verifies True."""
+        good_pool = _pool_with_atts(genesis, 2, [0])
+        bad_pool = _poisoned_pool(genesis, 1)
+        good_pool.pubkey_table = bad_pool.pubkey_table
+        sched = StreamScheduler(max_slots=2, linger_s=60)
+        bisects = _counter("megabatch_bisects")
+        h_bad = sched.submit(
+            bad_pool.build_slot_batch_indexed(genesis, 1))
+        h_good = sched.submit(
+            good_pool.build_slot_batch_indexed(genesis, 2))
+        assert sched.result(h_good) is True
+        assert sched.result(h_bad) is False
+        assert _counter("megabatch_bisects") == bisects + 1
+
+    @pytest.mark.chaos
+    def test_full_fault_rate_bisects_to_golden_verdicts(self, genesis):
+        """100% device_dispatch faults: megabatch dispatch fails, the
+        one retry fails, bisection hands each slot to its own PR-2
+        ladder — pure fallback returns the golden verdicts."""
+        good_pool = _pool_with_atts(genesis, 2, [0])
+        bad_pool = _poisoned_pool(genesis, 1)
+        good_pool.pubkey_table = bad_pool.pubkey_table
+        sched = StreamScheduler(max_slots=2, linger_s=60)
+        bisects = _counter("megabatch_bisects")
+        retries = _counter("megabatch_retries")
+        good_batch = good_pool.build_slot_batch_indexed(genesis, 2)
+        bad_batch = bad_pool.build_slot_batch_indexed(genesis, 1)
+        with faults.inject(device_dispatch=1.0):
+            h_bad = sched.submit(bad_batch)
+            h_good = sched.submit(good_batch)
+            assert sched.result(h_good) is True
+            assert sched.result(h_bad) is False
+        assert _counter("megabatch_bisects") == bisects + 1
+        assert _counter("megabatch_retries") == retries + 1
+        # the constituent batches carry their pure per-entry verdicts
+        assert good_batch.fallback_verdicts == [True]
+        want = [a.data.index == 1 for a in bad_batch.attestations]
+        assert bad_batch.fallback_verdicts == want
+
+    def test_non_transient_error_reraises_at_claim(self, genesis,
+                                                   monkeypatch):
+        from prysm_tpu.operations.attestations import IndexedSlotBatch
+
+        def bad_input(self, rng=None):
+            raise ValueError("garbage operand")
+
+        monkeypatch.setattr(IndexedSlotBatch, "verify_async",
+                            bad_input)
+        pool = _pool_with_atts(genesis, 1, [0])
+        sched = StreamScheduler(max_slots=1)
+        # empty inject shields from any env fault schedule: a random
+        # transient layered over the ValueError could degrade this to
+        # the pure rung instead of re-raising
+        with faults.inject():
+            h = sched.submit(pool.build_slot_batch_indexed(genesis, 1))
+            with pytest.raises(ValueError, match="garbage operand"):
+                sched.result(h)
+
+
+class TestBreakerDemotion:
+    def test_open_breaker_demotes_to_n1(self, genesis, monkeypatch):
+        """Breaker open: an N=4 scheduler flushes every submit as its
+        own single-slot megabatch through the slot's own (breaker-
+        gated) ladder — no fused megabatch aimed at a dead device."""
+        from prysm_tpu.operations.attestations import IndexedSlotBatch
+
+        monkeypatch.setattr(IndexedSlotBatch, "verify_each_pure",
+                            lambda self: [True] * len(self))
+        for _ in range(3):
+            bls.fused_breaker.record_failure()
+        assert bls.fused_breaker.is_open()
+        pool = _pool_with_atts(genesis, 1, [0, 1])
+        sched = StreamScheduler(max_slots=4, linger_s=60)
+        demotions = _counter("megabatch_demotions")
+        dispatches = _counter("megabatch_dispatches")
+        h1 = sched.submit(pool.build_slot_batch_indexed(genesis, 1))
+        h2 = sched.submit(pool.build_slot_batch_indexed(genesis, 1))
+        assert sched.result(h1) is True
+        assert sched.result(h2) is True
+        assert _counter("megabatch_demotions") == demotions + 2
+        assert _counter("megabatch_dispatches") == dispatches
+        assert sched.pending() == 0
+
+
+# --- fail-closed shutdown ----------------------------------------------------
+
+
+class TestCloseFailClosed:
+    def test_close_flushes_accumulated_slots_fail_closed(self, genesis):
+        """A partially-filled megabatch pending at close must resolve
+        (False) and be counted — never silently dropped."""
+        pool = _pool_with_atts(genesis, 1, [0])
+        sched = StreamScheduler(max_slots=8, linger_s=60)
+        h = sched.submit(pool.build_slot_batch_indexed(genesis, 1))
+        abandons = _counter("fail_closed_abandons")
+        closes = _counter("megabatch_flushes_close")
+        sched.close()
+        assert sched.result(h) is False
+        assert _counter("fail_closed_abandons") == abandons + 1
+        assert _counter("megabatch_flushes_close") == closes + 1
+        with pytest.raises(RuntimeError):
+            sched.submit(pool.build_slot_batch_indexed(genesis, 1))
+
+    def test_close_counts_every_slot_riding_an_inflight_ticket(
+            self, genesis):
+        pool = _pool_with_atts(genesis, 1, [0])
+        pool2 = _pool_with_atts(genesis, 2, [1])
+        pool2.pubkey_table = pool.pubkey_table
+        sched = StreamScheduler(max_slots=2, linger_s=60)
+        h1 = sched.submit(pool.build_slot_batch_indexed(genesis, 1))
+        h2 = sched.submit(pool2.build_slot_batch_indexed(genesis, 2))
+        # both slots ride ONE in-flight ticket now
+        abandons = _counter("fail_closed_abandons")
+        sched.close()
+        assert sched.result(h1) is False
+        assert sched.result(h2) is False
+        assert _counter("fail_closed_abandons") == abandons + 2
+
+
+# --- service integration -----------------------------------------------------
+
+
+class TestServiceIntegration:
+    def test_chain_scheduler_routes_slot_batch(self, genesis, types):
+        """The sync service's slot verify flows through the chain's
+        scheduler: verdicts unchanged, scheduler metrics move."""
+        from prysm_tpu.blockchain import BlockchainService
+        from prysm_tpu.core.helpers import latest_header_root
+        from prysm_tpu.db import BeaconDB
+        from prysm_tpu.p2p import GossipBus
+        from prysm_tpu.stategen import StateGen
+        from prysm_tpu.sync import SyncService
+
+        db = BeaconDB(":memory:", types=types)
+        stategen = StateGen(db, types=types)
+        root = latest_header_root(genesis)
+        chain = BlockchainService(db, stategen, genesis.copy(), root,
+                                  types=types)
+        bus = GossipBus()
+        pool = _pool_with_atts(genesis, 1, [0, 1])
+        sync = SyncService(bus.join("n0"), chain, pool, types=types)
+        slots = _counter("megabatch_slots_dispatched")
+        assert sync.verify_slot_batch(1) is True
+        assert _counter("megabatch_slots_dispatched") == slots + 1
+        chain.close()
+        db.close()
