@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow chaos stream warm-cache dryrun bench native proto
+.PHONY: test test-slow chaos stream soak warm-cache dryrun bench native proto
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -35,6 +35,17 @@ stream:
 		$(PY) -m pytest tests/test_sched.py -x -q
 	$(PY) -m pytest tests/test_sched.py -x -q
 	PRYSM_TIER_BUDGET=2400 $(PY) bench.py --tier stream_verify
+
+# Soak gate (ISSUE 7): thousands of slots of seeded adversarial
+# traffic (reorg storms, slashing floods, registry churn, signature
+# poisoning, a device-fault storm window) through the real streaming
+# scheduler — zero verdict divergence, >=1 full breaker
+# trip->probe->recover cycle, zero fail-closed abandons.  The soak-
+# marked tests are excluded from tier-1 (which still runs the 64-slot
+# smoke); the bench `soak` tier runs the same harness wall-bounded.
+soak:
+	$(PY) -m pytest tests/test_soak.py -q -m "soak or not soak" -x
+	PRYSM_TIER_BUDGET=900 $(PY) bench.py --tier soak
 
 # Populate the fingerprint-keyed CPU compile cache on THIS host.
 # Per-file processes keep each run's compile count low enough that

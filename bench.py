@@ -551,6 +551,42 @@ def bench_field_throughput():
     }
 
 
+def bench_soak():
+    """Soak tier: thousands of slots of seeded adversarial traffic
+    (reorg storms, slashing floods, registry churn, signature
+    poisoning, one device-fault storm window) through the real
+    streaming scheduler — ``runtime/scenarios.run_soak``.  The metric
+    of merit is sustained slots/sec with ZERO verdict divergence and
+    zero fail-closed abandons; the scenario/breaker counters ride
+    along in the tier JSON via the child-mode counter stamping."""
+    from prysm_tpu.config import set_features, use_minimal_config
+
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    from prysm_tpu.runtime.scenarios import run_soak
+
+    tier_budget = float(os.environ.get("PRYSM_TIER_BUDGET", "0"))
+    # leave headroom for teardown + JSON stamping under the alarm
+    deadline_s = tier_budget * 0.8 if tier_budget > 0 else None
+    # storm pinned early so even a deadline-clipped PARTIAL run still
+    # contains the full breaker trip->probe->recover cycle
+    report = run_soak(n_slots=2048, seed=1337, storm_start=16,
+                      deadline_s=deadline_s)
+    assert not report["divergences"], report["divergences"]
+    assert report["fail_closed_abandons"] == 0, report
+    assert report["breaker"]["trips"] >= 1, report["breaker"]
+    assert report["breaker"]["resets"] >= 1, report["breaker"]
+    return {
+        "metric": "soak_slots_per_sec",
+        "value": report["slots_per_sec"],
+        "unit": (f"slots/sec sustained ({report['slots']} slots"
+                 f"{', PARTIAL' if report['partial'] else ''}; 0 "
+                 f"divergences, {report['breaker']['trips']:.0f} "
+                 f"breaker cycles)"),
+        "vs_baseline": 0.0,
+    }
+
+
 TIERS = [
     # (name, fn, wall budget seconds — generous for first compiles;
     # the persistent cache makes reruns fast)
@@ -565,6 +601,7 @@ TIERS = [
     ("htr_registry", bench_htr_registry, 500),
     ("htr_state_warm", bench_htr_state_warm, 900),
     ("field_throughput", bench_field_throughput, 300),
+    ("soak", bench_soak, 900),
 ]
 
 # the five BASELINE.json configs (plus companions) recorded every
@@ -573,7 +610,48 @@ TIERS = [
 FULL_TIERS = ("single_verify", "aggregate_verify", "slot_verify",
               "slot_throughput", "slot_pipeline", "stream_verify",
               "htr_registry", "htr_state_warm", "epoch_replay",
-              "epoch_replay_16k")
+              "epoch_replay_16k", "soak")
+
+
+# --- harness self-test hooks (tests/test_bench_harness.py) ------------------
+# PRYSM_BENCH_FAKE_TIERS=1 swaps the real tiers for three tiny fakes so
+# the PARENT-side deadline machinery can be regression-tested in
+# seconds: fake_hang ignores SIGTERM/SIGALRM and parks a grandchild on
+# the stdout pipe (the exact shape that wedged round 4's driver into
+# rc=124), fake_ok/fake_ok2 return instantly.
+
+
+def _fake_ok():
+    return {"metric": "fake_ok", "value": 1, "unit": "ok",
+            "vs_baseline": 1.0}
+
+
+def _fake_ok2():
+    return {"metric": "fake_ok2", "value": 2, "unit": "ok",
+            "vs_baseline": 1.0}
+
+
+def _fake_hang():             # pragma: no cover — killed from outside
+    import signal
+    import subprocess
+
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    signal.signal(signal.SIGALRM, signal.SIG_IGN)
+    # the grandchild inherits this process's stdout/stderr pipes and
+    # holds them open long after the direct child is killed — a
+    # parent that read()s after kill() instead of killing the whole
+    # process group blocks here forever
+    subprocess.Popen(["sleep", "3600"])
+    while True:
+        time.sleep(60)
+
+
+if os.environ.get("PRYSM_BENCH_FAKE_TIERS", "0") == "1":
+    _fake_budget = float(os.environ.get("PRYSM_BENCH_FAKE_BUDGET", "5"))
+    TIERS = [("fake_hang", _fake_hang, _fake_budget),
+             ("fake_ok", _fake_ok, _fake_budget),
+             ("fake_ok2", _fake_ok2, _fake_budget)]
+    FULL_TIERS = ("fake_hang", "fake_ok", "fake_ok2")
 
 
 def _run_tier_subprocess(name: str, budget: float) -> str | None:
@@ -584,21 +662,43 @@ def _run_tier_subprocess(name: str, budget: float) -> str | None:
     itself and report a PARTIAL number, and so the child's own alarm
     backstop fires even when bench is invoked tier-by-tier by hand.
     Compile work is shared with later runs through the persistent
-    cache."""
+    cache.
+
+    The child runs as its own SESSION (process group) and an overrun
+    is killed with killpg — BENCH_r04 regression: ``subprocess.run``'s
+    TimeoutExpired path kills only the direct child and then blocks in
+    an unbounded ``communicate()`` on pipes any grandchild (XLA
+    compile helpers, a wedged tier's workers) still holds, turning a
+    per-tier timeout into a whole-round rc=124."""
     import subprocess
 
     env = dict(os.environ)
     env["PRYSM_TIER_BUDGET"] = str(budget)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--tier", name],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        start_new_session=True)
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--tier", name],
-            capture_output=True, text=True, timeout=budget,
-            cwd=os.path.dirname(os.path.abspath(__file__)), env=env)
+        out, err = proc.communicate(timeout=budget)
     except subprocess.TimeoutExpired:
-        print(f"# tier {name} exceeded {budget:.0f}s", file=sys.stderr)
+        import signal as _signal
+
+        try:
+            os.killpg(proc.pid, _signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            # bounded: the group is dead, but never bet the round on it
+            out, err = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        print(f"# tier {name} exceeded {budget:.0f}s (killed group)",
+              file=sys.stderr)
+        sys.stderr.write(err or "")
         return None
-    sys.stderr.write(proc.stderr)
-    for line in proc.stdout.splitlines():
+    sys.stderr.write(err)
+    for line in out.splitlines():
         line = line.strip()
         if line.startswith("{"):
             return line
@@ -611,7 +711,9 @@ def _run_tier_subprocess(name: str, budget: float) -> str | None:
 # shared deadline), and tiers that don't fit report FAILED/timeout in
 # their BENCH_FULL.json slot instead of silently hanging the round.
 _TOTAL_BUDGET = float(os.environ.get("PRYSM_BENCH_BUDGET", "3300"))
-_MIN_TIER_SLICE = 60.0      # below this, don't even start a tier
+# below this, don't even start a tier (env-overridable so the fake-
+# tier harness self-test can run with seconds-scale budgets)
+_MIN_TIER_SLICE = float(os.environ.get("PRYSM_BENCH_MIN_SLICE", "60"))
 
 
 def _timeout_result(name: str, reason: str = "FAILED/timeout") -> dict:
@@ -621,9 +723,11 @@ def _timeout_result(name: str, reason: str = "FAILED/timeout") -> dict:
 
 def _write_full(results: dict) -> None:
     """Rewrite BENCH_FULL.json after EVERY tier: a driver-side kill
-    mid-sweep preserves the tiers that did complete."""
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "BENCH_FULL.json")
+    mid-sweep preserves the tiers that did complete.  The path is
+    overridable (PRYSM_BENCH_FULL_PATH) so harness self-tests never
+    clobber the committed sweep."""
+    out = os.environ.get("PRYSM_BENCH_FULL_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_FULL.json")
     with open(out, "w") as f:
         json.dump(results, f, indent=2)
 
@@ -667,7 +771,11 @@ def main() -> None:
             result["breaker_trips"] = _m.counter("breaker_trips").value
             for mname in ("megabatch_slots_dispatched",
                           "megabatch_dispatches", "megabatch_retries",
-                          "megabatch_bisects", "megabatch_demotions"):
+                          "megabatch_bisects", "megabatch_demotions",
+                          "bisection_device_verifies",
+                          "bisection_isolations", "fail_closed_abandons",
+                          "reorgs_applied", "slashings_injected",
+                          "registry_churn_events", "soak_slots"):
                 v = _m.counter(mname).value
                 if v:
                     result[mname] = v

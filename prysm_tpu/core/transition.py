@@ -293,6 +293,20 @@ def pop_registry_changes(state) -> tuple:
     return tuple(sorted(changes)) if changes else ()
 
 
+def append_validator(state, validator, balance: int) -> int:
+    """Append one validator to the registry AND note the registry
+    change so device pubkey tables scatter-sync the new row.  The
+    single entry point for every registry append outside the deposit
+    proof path (genesis import, cross-fork surgery, scenario storms)
+    — appending without the note leaves device tables to discover the
+    row by tail-check, which a same-length in-place edit defeats."""
+    state.validators.append(validator)
+    state.balances.append(balance)
+    index = len(state.validators) - 1
+    _note_registry_change(state, index)
+    return index
+
+
 def process_deposit(state, deposit) -> None:
     from ..proto import DEPOSIT_CONTRACT_TREE_DEPTH
 
@@ -326,7 +340,7 @@ def process_deposit(state, deposit) -> None:
 
         eff = min(amount - amount % cfg.effective_balance_increment,
                   cfg.max_effective_balance)
-        state.validators.append(Validator(
+        append_validator(state, Validator(
             pubkey=pubkey,
             withdrawal_credentials=deposit.data.withdrawal_credentials,
             effective_balance=eff,
@@ -335,9 +349,7 @@ def process_deposit(state, deposit) -> None:
             activation_epoch=FAR_FUTURE_EPOCH,
             exit_epoch=FAR_FUTURE_EPOCH,
             withdrawable_epoch=FAR_FUTURE_EPOCH,
-        ))
-        state.balances.append(amount)
-        _note_registry_change(state, len(state.validators) - 1)
+        ), amount)
     else:
         increase_balance(state, known[pubkey], amount)
 

@@ -94,7 +94,8 @@ class SlotDispatcher:
                 return
             tag, dev = self._entries[target]
         try:
-            resolved = bool(np.asarray(_faults.fire("readback", dev)))
+            resolved = bool(np.asarray(_faults.fire(
+                "partial_readback", _faults.fire("readback", dev))))
         except Exception as e:      # noqa: BLE001 — repropagated
             # a failed buffer-bound readback belongs to the DRAINED
             # ticket, not the submit that triggered the drain: store
@@ -134,7 +135,8 @@ class SlotDispatcher:
         tag, payload = entry
         if tag == "err":
             raise payload
-        return bool(np.asarray(_faults.fire("readback", payload)))
+        return bool(np.asarray(_faults.fire(
+            "partial_readback", _faults.fire("readback", payload))))
 
     def failed(self, ticket: int):
         """Peek at ``ticket``'s captured exception (or None) WITHOUT

@@ -186,6 +186,62 @@ class ForkChoiceStore:
             idx = node.parent
         return None
 
+    # --- structural invariants ----------------------------------------------
+
+    def check_invariants(self) -> list[str]:
+        """Structural invariants a reorg can never legally break;
+        returns human-readable violations (empty = healthy).  Cheap
+        enough (O(n)) for adversarial-scenario harnesses to call
+        after every storm step:
+
+        * ``index_by_root`` is a bijection onto ``nodes``;
+        * parent/children links are mutually consistent;
+        * subtree weights are non-negative and each node's weight
+          covers the sum of its children's (delta propagation can
+          only ADD the node's own votes on top);
+        * ``best_child``/``best_descendant`` point at real,
+          consistent nodes (the best descendant of a node is the
+          best descendant of its best child).
+        """
+        out: list[str] = []
+        n = len(self.nodes)
+        if len(self.index_by_root) != n:
+            out.append("index_by_root size != node count")
+        for root, i in self.index_by_root.items():
+            if not (0 <= i < n) or self.nodes[i].root != root:
+                out.append(f"index_by_root[{root.hex()[:8]}] broken")
+        for i, node in enumerate(self.nodes):
+            if node.parent != NO_INDEX:
+                if not (0 <= node.parent < n):
+                    out.append(f"node {i}: parent out of range")
+                elif i not in self.nodes[node.parent].children:
+                    out.append(f"node {i}: missing from parent's "
+                               f"children")
+            child_sum = 0
+            for c in node.children:
+                if not (0 <= c < n) or self.nodes[c].parent != i:
+                    out.append(f"node {i}: child {c} link broken")
+                else:
+                    child_sum += self.nodes[c].weight
+            if node.weight < 0:
+                out.append(f"node {i}: negative weight {node.weight}")
+            if node.weight < child_sum:
+                out.append(f"node {i}: weight {node.weight} < children "
+                           f"sum {child_sum}")
+            for tag, p in (("best_child", node.best_child),
+                           ("best_descendant", node.best_descendant)):
+                if p != NO_INDEX and not (0 <= p < n):
+                    out.append(f"node {i}: {tag} out of range")
+            if node.best_child != NO_INDEX and 0 <= node.best_child < n:
+                bc = self.nodes[node.best_child]
+                expect = (bc.best_descendant
+                          if bc.best_descendant != NO_INDEX
+                          else node.best_child)
+                if node.best_descendant != expect:
+                    out.append(f"node {i}: best_descendant "
+                               f"inconsistent with best_child")
+        return out
+
     # --- internals ---------------------------------------------------------
 
     def _tree_root_index(self) -> int:

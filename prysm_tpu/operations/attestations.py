@@ -333,10 +333,12 @@ class IndexedSlotBatch:
     # the attestation objects the batch covers, captured under the
     # pool lock — the ONLY list a verdict consumer may act on (TOCTOU)
     attestations: list
-    # set by verify() when the fused device path failed and the pure
-    # per-entry rung produced the verdicts: one bool per batch entry,
-    # in entry order.  Consumers (sync.verify_slot_batch) use these
-    # instead of re-dispatching each entry onto the failing device.
+    # per-entry verdicts, one bool per batch entry in entry order, set
+    # when a rung below the whole-batch dispatch produced them: the
+    # degraded pure rung of verify(), or the ON-DEVICE bisection rung
+    # (bisect_verify via the megabatch scheduler).  Consumers
+    # (sync.verify_slot_batch) use these instead of re-dispatching
+    # each entry individually.
     fallback_verdicts: list | None = None
 
     @staticmethod
@@ -404,6 +406,13 @@ class IndexedSlotBatch:
         raw = np.frombuffer(
             b"".join(list(self.sig_bytes) + [inf_sig] * (ab - a)),
             dtype=np.uint8).reshape(ab, 96)
+        # sub-dispatch seam: per-limb corruption of the packed device
+        # buffers (DMA/HBM bitflip).  Fired on the signature buffer —
+        # the fail-closed graph turns a flipped limb into a CLEAN
+        # False, and any re-pack (retry, bisection) heals it because
+        # packing restarts from the host-side bytes.
+        raw = np.asarray(_faults.fire("device_buffer", raw),
+                         dtype=np.uint8)
         sig_x, sig_i, sig_s, sig_wf = parse_g2_compressed(raw)
         u0, u1 = hash_to_field_host(
             list(self.roots) + [b""] * (ab - a), ETH2_DST)
@@ -464,7 +473,9 @@ class IndexedSlotBatch:
         if fused_breaker.allow():
             for attempt in (0, 1):
                 try:
-                    v = _faults.fire("readback", self.verify_async(rng))
+                    v = _faults.fire(
+                        "partial_readback",
+                        _faults.fire("readback", self.verify_async(rng)))
                     ok = bool(np.asarray(v))
                 except Exception as e:   # noqa: BLE001 — classified
                     if not _faults.is_transient(e):
@@ -480,6 +491,69 @@ class IndexedSlotBatch:
         _m.inc("degraded_dispatches")
         self.fallback_verdicts = self.verify_each_pure()
         return all(self.fallback_verdicts)
+
+    def subset(self, entries) -> "IndexedSlotBatch":
+        """A new batch over entry POSITIONS ``entries`` (the bisection
+        halves).  Shares the registry table; host arrays are sliced
+        copies, so re-verifying a subset re-packs from pristine host
+        bytes (which is what heals a transient buffer corruption).
+        The K axis is kept as-is — ``device_args`` re-buckets the A
+        axis, so halves of a bucket-padded batch land on power-of-two
+        shapes the compile cache already holds."""
+        import numpy as np
+
+        sel = list(entries)
+        return IndexedSlotBatch(
+            idx=np.asarray(self.idx)[sel].copy(),
+            mask=np.asarray(self.mask)[sel].copy(),
+            roots=[self.roots[i] for i in sel],
+            sig_bytes=[self.sig_bytes[i] for i in sel],
+            descriptions=[self.descriptions[i] for i in sel],
+            table=self.table,
+            attestations=[self.attestations[i] for i in sel
+                          if i < len(self.attestations)])
+
+    def bisect_verify(self, rng=None, whole_false: bool = True) -> list:
+        """ON-DEVICE bisection: per-entry verdicts for a batch whose
+        whole-batch RLC check came back a clean False, using log₂
+        re-verifies of halves — every probe is the SAME fused graph
+        over a subset, so ``b`` bad entries cost O(b·log₂A) device
+        dispatches instead of A per-signature pure fallbacks.  The
+        rung between the megabatch whole-retry and the pure ladder.
+
+        Returns one bool per entry.  A transient device fault mid-
+        bisection propagates to the caller (which falls back to the
+        per-slot pure ladder); with ``whole_false`` the root range is
+        taken as already-refuted and only the halves dispatch."""
+        import numpy as np
+
+        from ..monitoring.metrics import metrics as _m
+        from ..runtime import faults as _faults
+
+        n = len(self)
+        verdicts: list = [None] * n
+        # (lo, hi, known_false): ranges still to resolve
+        stack = [(0, n, whole_false)]
+        while stack:
+            lo, hi, known_false = stack.pop()
+            if not known_false:
+                _m.inc("bisection_device_verifies")
+                sub = self.subset(range(lo, hi))
+                v = _faults.fire(
+                    "partial_readback",
+                    _faults.fire("readback", sub.verify_async(rng)))
+                if bool(np.asarray(v)):
+                    for i in range(lo, hi):
+                        verdicts[i] = True
+                    continue
+            if hi - lo == 1:
+                verdicts[lo] = False
+                _m.inc("bisection_isolations")
+                continue
+            mid = (lo + hi) // 2
+            stack.append((mid, hi, False))
+            stack.append((lo, mid, False))
+        return verdicts
 
     def verify_each_pure(self) -> list:
         """Per-entry host-golden-model verdicts (the degraded rung):

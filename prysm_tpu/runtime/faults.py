@@ -13,19 +13,31 @@ module is both halves of that story:
   no schedule installed, :func:`fire` is a None-check — zero overhead
   on the hot path.
 
-  Injection points (the pipeline seams, host side of each dispatch):
+  Injection points (the pipeline seams, host side of each dispatch,
+  plus two SUB-dispatch seams modeling corruption below the seam):
 
-  ===================  ====================================================
-  ``device_dispatch``  the fused slot-verify jit dispatch
-                       (``IndexedSlotBatch.verify_async``)
-  ``readback``         host readback of a device verdict
-                       (``np.asarray`` in batch verify / SlotDispatcher)
-  ``pubkey_sync``      registry-table decompress dispatch
-                       (``PubkeyTable._decompress_rows``)
-  ``h2c_pack``         host hash-to-field packing
-                       (``IndexedSlotBatch.device_args``)
-  ``backend_select``   backend resolution (``bls._backend``)
-  ===================  ====================================================
+  ====================  ===================================================
+  ``device_dispatch``   the fused slot-verify jit dispatch
+                        (``IndexedSlotBatch.verify_async``)
+  ``readback``          host readback of a device verdict
+                        (``np.asarray`` in batch verify / SlotDispatcher)
+  ``pubkey_sync``       registry-table decompress dispatch
+                        (``PubkeyTable._decompress_rows``)
+  ``h2c_pack``          host hash-to-field packing
+                        (``IndexedSlotBatch.device_args``)
+  ``backend_select``    backend resolution (``bls._backend``)
+  ``device_buffer``     the packed device input buffers
+                        (``IndexedSlotBatch.device_args``): corrupt
+                        mode flips one limb bit in the signature
+                        buffer — a DMA/HBM bitflip below the dispatch
+                        seam.  The fused graph is fail-closed, so a
+                        flipped limb surfaces as a CLEAN False, not an
+                        exception; a re-pack (retry/bisection) heals it
+  ``partial_readback``  truncated/partial verdict readback: corrupt
+                        mode returns a payload whose conversion raises
+                        (a short DMA that delivered only part of the
+                        buffer), classified transient like ``readback``
+  ====================  ===================================================
 
   Install via the ``PRYSM_TPU_FAULTS`` env var (read once at import)
   or the :func:`inject` context manager (tests, bench)::
@@ -64,7 +76,7 @@ import time
 from contextlib import contextmanager
 
 _POINTS = ("device_dispatch", "readback", "pubkey_sync", "h2c_pack",
-           "backend_select")
+           "backend_select", "device_buffer", "partial_readback")
 
 
 class FaultError(RuntimeError):
@@ -82,10 +94,38 @@ class _CorruptedReadback:
         raise FaultError("injected corrupt readback")
 
 
+class _TruncatedReadback:
+    """corrupt-mode partial-readback payload: the DMA delivered only a
+    prefix of the verdict buffer, so the conversion itself fails —
+    transient, like a torn readback, but at the sub-dispatch seam."""
+
+    def __bool__(self):
+        raise FaultError("injected truncated readback (partial buffer)")
+
+    def __array__(self, dtype=None, copy=None):
+        raise FaultError("injected truncated readback (partial buffer)")
+
+
+def _corrupt_limb(payload):
+    """corrupt-mode device-buffer payload: flip ONE bit of the first
+    limb — the smallest possible HBM/DMA corruption.  Non-array
+    payloads (the seam fired without a buffer) degrade to raising."""
+    import numpy as np
+
+    if payload is None:
+        raise FaultError("injected device-buffer corruption (no buffer)")
+    arr = np.array(payload, copy=True)
+    flat = arr.reshape(-1)
+    flat[0] = flat[0] ^ type(flat[0])(1)
+    return arr
+
+
 # corrupt-mode payload transforms per point; points without one raise
 _CORRUPTORS = {
     "backend_select": lambda payload: "pure",
     "readback": lambda payload: _CorruptedReadback(),
+    "device_buffer": _corrupt_limb,
+    "partial_readback": lambda payload: _TruncatedReadback(),
 }
 
 
@@ -275,11 +315,16 @@ def is_transient(exc: BaseException) -> bool:
         return True
     if isinstance(exc, (ValueError, TypeError, AssertionError)):
         return False
-    t = type(exc)
-    if t.__name__ in _TRANSIENT_NAMES:
-        return True
-    mod = t.__module__ or ""
-    return mod.startswith(("jaxlib", "jax."))
+    # walk the MRO so SUBCLASSES of the device-runtime errors classify
+    # too: on the real chip jaxlib raises XlaRuntimeError (and pjrt
+    # wrappers derived from it) — the ladder must degrade, not crash
+    for t in type(exc).__mro__:
+        if t.__name__ in _TRANSIENT_NAMES:
+            return True
+        mod = t.__module__ or ""
+        if mod.startswith(("jaxlib", "jax.")):
+            return True
+    return False
 
 
 # --- circuit breaker -------------------------------------------------------

@@ -15,13 +15,19 @@ higher):
 1. the fused megabatch dispatch; a TRANSIENT failure retries the
    whole megabatch once (``megabatch_retries``, via the dispatcher's
    order-preserving ``resubmit``);
-2. a megabatch that still fails — or verifies False with more than
-   one slot aboard — BISECTS into its constituent per-slot batches
-   (``megabatch_bisects``): each slot re-verifies through its own
-   PR-2 ladder (fused -> bounded retry -> per-attestation pure
-   fallback), so one poisoned slot costs one slot's fallback, never
-   the megabatch's;
-3. while the fused circuit breaker is open the scheduler demotes to
+2. a megabatch whose RLC check comes back a CLEAN False (no device
+   fault — some attestation aboard is poisoned) BISECTS ON-DEVICE
+   (``megabatch_bisects``): ``IndexedSlotBatch.bisect_verify`` halves
+   the joined batch and re-dispatches each half through the SAME
+   fused graph, isolating every bad attestation in O(bad·log₂A)
+   device probes (``bisection_isolations``) — per-entry verdicts land
+   in each constituent batch's ``fallback_verdicts`` and the
+   per-signature pure fallback is never touched;
+3. a megabatch that still FAULTS after the retry feeds the breaker
+   and falls apart into its constituent per-slot PR-2 ladders (fused
+   -> bounded retry -> per-attestation pure fallback) — likewise a
+   bisection interrupted by a device fault;
+4. while the fused circuit breaker is open the scheduler demotes to
    N=1 (``megabatch_demotions``) and routes each slot through
    ``IndexedSlotBatch.verify`` directly — the breaker's allow/probe
    machinery governs device recovery, exactly as in the per-slot path.
@@ -209,19 +215,48 @@ class StreamScheduler:
             _breaker().record_success()
             for h, _b in mb.entries:
                 self._verdicts[h] = True
-        elif len(mb.entries) == 1:
-            # a clean single-slot False is a VERDICT, not a fault:
-            # the consumer's own per-attestation recovery takes over
-            # (identical to the fused per-slot path's semantics)
+        elif len(mb.joined) == 1:
+            # a clean single-attestation False is already fully
+            # isolated — a VERDICT, not a fault: the consumer's own
+            # per-attestation recovery takes over (identical to the
+            # fused per-slot path's semantics)
             _breaker().record_success()
             self._verdicts[mb.entries[0][0]] = False
         else:
-            # the RLC check rejected the megabatch: some slot is
-            # poisoned — bisect to isolate it instead of collapsing
-            # everything to per-attestation fallback
+            # the RLC check rejected the megabatch cleanly: some
+            # attestation aboard is poisoned — bisect ON-DEVICE to
+            # isolate the bad entries instead of collapsing to the
+            # per-signature pure fallback
             _breaker().record_success()
-            self._settle_by_slot(mb, bisected=True)
+            self._bisect_megabatch(mb)
         self._observe_amortized(mb)
+
+    def _bisect_megabatch(self, mb) -> None:
+        """The on-device bisection rung: re-verify halves of the
+        joined megabatch through the SAME fused graph until every bad
+        attestation is isolated (``IndexedSlotBatch.bisect_verify``),
+        then demux the per-entry verdicts back onto the constituent
+        batches' ``fallback_verdicts`` — consumers read them exactly
+        as they read the pure rung's, but no per-signature pure
+        fallback ever ran.  A device fault mid-bisection falls back
+        to the per-slot PR-2 ladders."""
+        _metrics().inc("megabatch_bisects")
+        try:
+            entry_verdicts = mb.joined.bisect_verify(self._rng)
+        except Exception as e:   # noqa: BLE001 — classified below
+            if _faults.is_transient(e):
+                _breaker().record_failure()
+            # transient or not, the per-slot ladders isolate the
+            # culprit (a non-transient packing error re-raises only
+            # from ITS slot's claim)
+            self._settle_by_slot(mb)
+            return
+        pos = 0
+        for h, b in mb.entries:
+            sub = list(entry_verdicts[pos:pos + len(b)])
+            pos += len(b)
+            b.fallback_verdicts = sub
+            self._verdicts[h] = all(sub)
 
     def _settle_by_slot(self, mb, bisected: bool = False) -> None:
         """Re-verify each constituent slot batch through its OWN PR-2

@@ -1,0 +1,114 @@
+"""Bench harness self-test (ISSUE 7 satellite: BENCH_r04 regression).
+
+Round 4 post-mortem: a wedged tier ate the whole bench round.  The
+parent used ``subprocess.run(timeout=...)``, whose TimeoutExpired path
+kills only the DIRECT child and then blocks in an unbounded
+``communicate()`` on pipes a grandchild still holds — the per-tier
+timeout became a round-level rc=124 and every number was lost.
+
+These tests run ``bench.py`` for real with PRYSM_BENCH_FAKE_TIERS=1:
+``fake_hang`` ignores SIGTERM/SIGALRM and parks a ``sleep`` grandchild
+on the stdout pipe (the exact wedge shape); the parent must kill the
+whole process GROUP at the tier budget, print the metric-of-record
+line from the next tier, emit JSON for every other tier, and exit 0 —
+all in seconds, not hours.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+def _run_fake_bench(tmp_path, fake_budget: float = 3.0,
+                    extra_env: dict | None = None):
+    env = dict(os.environ)
+    env.update({
+        "PRYSM_BENCH_FAKE_TIERS": "1",
+        "PRYSM_BENCH_FAKE_BUDGET": str(fake_budget),
+        "PRYSM_BENCH_MIN_SLICE": "1",
+        "PRYSM_BENCH_BUDGET": "60",
+        "PRYSM_BENCH_FULL": "1",
+        "PRYSM_BENCH_FULL_PATH": str(tmp_path / "fake_full.json"),
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.update(extra_env or {})
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, _BENCH], capture_output=True, text=True,
+        timeout=120, env=env, cwd=os.path.dirname(_BENCH))
+    return proc, time.monotonic() - t0
+
+
+@pytest.fixture(scope="module")
+def fake_round(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("bench")
+    proc, elapsed = _run_fake_bench(tmp, fake_budget=3.0)
+    full = json.loads((tmp / "fake_full.json").read_text())
+    return proc, elapsed, full
+
+
+def test_hung_tier_is_killed_at_the_parent_side_deadline(fake_round):
+    proc, elapsed, _full = fake_round
+    assert proc.returncode == 0
+    # the hang tier's budget is 3s; the grandchild sleeps 3600s.  The
+    # whole ROUND finishing in seconds proves the group kill: with the
+    # old run()+communicate() shape this blocks until the grandchild
+    # exits (observed as the driver's rc=124)
+    assert elapsed < 60, f"round took {elapsed:.0f}s — parent blocked"
+    assert "exceeded 3s" in proc.stderr
+
+def test_metric_of_record_still_printed_after_a_hung_tier(fake_round):
+    proc, _elapsed, _full = fake_round
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, proc.stdout
+    metric = json.loads(lines[0])
+    # fall-through: fake_hang timed out, fake_ok is the record
+    assert metric["metric"] == "fake_ok"
+    assert metric["value"] == 1
+
+
+def test_round_emits_json_for_every_other_tier(fake_round):
+    _proc, _elapsed, full = fake_round
+    assert set(full) == {"fake_hang", "fake_ok", "fake_ok2"}
+    assert full["fake_hang"]["unit"].startswith("FAILED/timeout")
+    assert full["fake_ok"]["value"] == 1
+    assert full["fake_ok2"]["value"] == 2
+    # counter stamping rides along on real (child-mode) tiers
+    assert "degraded_dispatches" in full["fake_ok"]
+
+
+def test_full_path_override_never_clobbers_committed_sweep(fake_round):
+    # the committed BENCH_FULL.json (repo root) must be untouched by
+    # the fake round — the tests above wrote to tmp_path instead
+    committed = os.path.join(os.path.dirname(_BENCH), "BENCH_FULL.json")
+    if os.path.exists(committed):
+        data = json.loads(open(committed).read())
+        assert "fake_ok" not in data
+
+
+def test_soak_tier_is_registered():
+    """The soak tier is part of the bench surface: present in TIERS
+    (with a budget) and swept into BENCH_FULL.json."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_bench_mod", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    env_had = os.environ.pop("PRYSM_BENCH_FAKE_TIERS", None)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        if env_had is not None:
+            os.environ["PRYSM_BENCH_FAKE_TIERS"] = env_had
+    names = [n for n, _f, _b in mod.TIERS]
+    assert "soak" in names
+    assert "soak" in mod.FULL_TIERS
+    budget = dict((n, b) for n, _f, b in mod.TIERS)["soak"]
+    assert budget >= 300

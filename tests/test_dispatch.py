@@ -140,3 +140,61 @@ def test_resubmit_refuses_abandoned_and_closed():
     d.close()
     with pytest.raises(RuntimeError, match="closed"):
         d.resubmit(99, lambda: True)
+
+
+def test_abandon_and_close_return_counts_are_idempotent():
+    from prysm_tpu.monitoring.metrics import metrics
+
+    d = SlotDispatcher()
+    t0 = d.submit(lambda: True)
+    t1 = d.submit(lambda: True)
+    before = metrics.counter("fail_closed_abandons").value
+    assert d.abandon(t0) == 1
+    assert d.abandon(t0) == 0      # already abandoned: counts 0
+    assert d.close() == 1          # only t1 newly abandoned
+    assert d.close() == 0          # second close: nothing left
+    assert metrics.counter("fail_closed_abandons").value == before + 2
+    assert d.result(t0) is False
+    assert d.result(t1) is False
+
+
+def test_concurrent_close_and_abandon_count_each_ticket_once():
+    """Hammer close() and two abandoners from racing threads: every
+    ticket lands in fail_closed_abandons EXACTLY once, whichever
+    caller got there first — the scheduler's close() tops the metric
+    up from these return values, so a double count here becomes a
+    phantom abandoned slot in the soak report."""
+    import threading
+
+    from prysm_tpu.monitoring.metrics import metrics
+
+    n = 32
+    for _trial in range(8):
+        d = SlotDispatcher(max_in_flight=2 * n)
+        tickets = [d.submit(lambda: True) for _ in range(n)]
+        before = metrics.counter("fail_closed_abandons").value
+        counts = []
+        barrier = threading.Barrier(3)
+
+        def closer(d=d, counts=counts, barrier=barrier):
+            barrier.wait()
+            counts.append(d.close())
+
+        def abandoner(ts, d=d, counts=counts, barrier=barrier):
+            barrier.wait()
+            counts.append(sum(d.abandon(t) for t in ts))
+
+        threads = [
+            threading.Thread(target=closer),
+            threading.Thread(target=abandoner, args=(tickets[::2],)),
+            threading.Thread(target=abandoner, args=(tickets[1::2],)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(counts) == n, counts
+        assert (metrics.counter("fail_closed_abandons").value
+                == before + n)
+        for t in tickets:
+            assert d.result(t) is False

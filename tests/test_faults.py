@@ -136,6 +136,40 @@ class TestFaultSchedule:
         with pytest.raises(faults.FaultError):
             bool(v)
 
+    def test_corrupt_device_buffer_flips_exactly_one_bit(self):
+        """The sub-dispatch seam: a 'DMA bitflip' must corrupt a COPY
+        of the packed buffer (one bit of the first limb), leaving the
+        host-side original pristine so a re-pack heals it."""
+        buf = np.zeros((2, 96), dtype=np.uint8)
+        with faults.inject(device_buffer={"rate": 1.0,
+                                          "mode": "corrupt"}):
+            out = faults.fire("device_buffer", buf)
+        assert out is not buf
+        assert (np.asarray(buf) == 0).all()      # original untouched
+        flipped = np.argwhere(np.asarray(out) != 0)
+        assert len(flipped) == 1                 # exactly one limb
+        assert out.reshape(-1)[0] == 1           # one bit, limb 0
+
+    def test_truncated_readback_is_transient_at_conversion(self):
+        """partial_readback corrupt mode: the payload looks delivered
+        but any attempt to materialize it raises a TRANSIENT fault —
+        the ladder retries instead of misreading half a verdict."""
+        with faults.inject(partial_readback={"rate": 1.0,
+                                             "mode": "corrupt"}):
+            v = faults.fire("partial_readback", True)
+        with pytest.raises(faults.FaultError) as ei:
+            bool(v)
+        assert faults.is_transient(ei.value)
+        with pytest.raises(faults.FaultError):
+            np.asarray(v)
+
+    def test_new_points_accept_env_schema(self):
+        s = faults.parse_spec(
+            "device_buffer:rate=1.0,mode=corrupt;"
+            "partial_readback:first=2")
+        assert s.points["device_buffer"].mode == "corrupt"
+        assert s.points["partial_readback"].first == 2
+
     def test_injection_counters_render(self):
         before = _counter("fault_injected_total")
         with faults.inject(h2c_pack=1.0) as s:
@@ -154,9 +188,39 @@ class TestTransientClassification:
 
         assert faults.is_transient(XlaRuntimeError("device lost"))
 
+    def test_real_jaxlib_xla_runtime_error_is_transient(self):
+        """The ACTUAL class jax raises on device aborts — not a
+        look-alike.  It subclasses RuntimeError, so a naive
+        isinstance(RuntimeError) check can't be the discriminator;
+        the classifier must catch it by name/module instead."""
+        try:
+            from jaxlib.xla_extension import XlaRuntimeError
+        except ImportError:
+            pytest.skip("jaxlib.xla_extension not importable")
+        exc = XlaRuntimeError("RESOURCE_EXHAUSTED: hbm oom")
+        assert isinstance(exc, RuntimeError)
+        assert faults.is_transient(exc)
+
+    def test_subclass_of_device_error_is_transient(self):
+        """MRO walk: a wrapper that SUBCLASSES a device error class
+        (common in retry/instrumentation shims) classifies by its
+        ancestry, not just its own name."""
+
+        class XlaRuntimeError(Exception):
+            pass
+
+        class WrappedDeviceLoss(XlaRuntimeError):
+            pass
+
+        assert faults.is_transient(WrappedDeviceLoss("wrapped"))
+
     def test_malformed_input_errors_are_not(self):
         assert not faults.is_transient(ValueError("bad signature"))
         assert not faults.is_transient(TypeError("bad arg"))
+        assert not faults.is_transient(AssertionError("broken pack"))
+        # a plain RuntimeError is NOT transient — only device-error
+        # names/modules earn a retry
+        assert not faults.is_transient(RuntimeError("logic bug"))
 
 
 # --- the degradation ladder --------------------------------------------------

@@ -282,6 +282,37 @@ class TestBisection:
         assert sched.result(h_bad) is False
         assert _counter("megabatch_bisects") == bisects + 1
 
+    def test_on_device_bisection_never_touches_pure_fallback(
+            self, genesis):
+        """ISSUE 7 acceptance: a clean-False megabatch is settled by
+        ON-DEVICE bisection — per-entry golden verdicts land in
+        ``fallback_verdicts``, the isolation is counted, and the
+        per-signature pure fallback counter does NOT move."""
+        good_pool = _pool_with_atts(genesis, 2, [0])
+        bad_pool = _poisoned_pool(genesis, 1)
+        good_pool.pubkey_table = bad_pool.pubkey_table
+        sched = StreamScheduler(max_slots=2, linger_s=60)
+        degraded = _counter("degraded_dispatches")
+        isolations = _counter("bisection_isolations")
+        device_verifies = _counter("bisection_device_verifies")
+        good_batch = good_pool.build_slot_batch_indexed(genesis, 2)
+        bad_batch = bad_pool.build_slot_batch_indexed(genesis, 1)
+        # empty inject shields from any env fault schedule — the rung
+        # under test is the CLEAN-False one
+        with faults.inject():
+            h_bad = sched.submit(bad_batch)
+            h_good = sched.submit(good_batch)
+            assert sched.result(h_good) is True
+            assert sched.result(h_bad) is False
+        # exactly one bad attestation isolated, all on-device
+        assert _counter("bisection_isolations") == isolations + 1
+        assert _counter("bisection_device_verifies") > device_verifies
+        assert _counter("degraded_dispatches") == degraded
+        # per-entry verdicts match the golden model on every entry
+        assert good_batch.fallback_verdicts == [True]
+        want = [a.data.index == 1 for a in bad_batch.attestations]
+        assert bad_batch.fallback_verdicts == want
+
     @pytest.mark.chaos
     def test_full_fault_rate_bisects_to_golden_verdicts(self, genesis):
         """100% device_dispatch faults: megabatch dispatch fails, the

@@ -1,0 +1,118 @@
+"""Long-running soak harness (ISSUE 7 tentpole d).
+
+``run_soak`` drives sustained adversarial load — reorg storms,
+slashing floods, registry churn, signature poisoning, one seeded
+device-fault storm — through the REAL streaming scheduler, breaker,
+and PubkeyTable sync machinery (synthetic MAC crypto; see the module
+docstring of ``runtime/scenarios.py``).
+
+Two shapes:
+
+* the SMOKE (64 slots) runs inside tier-1 on every push — acceptance:
+  at least one full breaker trip->probe->recover cycle, ZERO verdict
+  divergence from the golden model, zero fail-closed abandons, and a
+  fallback rate bounded by the duress window;
+* the FULL soak (thousands of slots, ``make soak`` / the ``soak``
+  bench tier) is marked ``soak`` + ``slow`` and excluded from tier-1.
+"""
+
+import pytest
+
+from prysm_tpu.config import (
+    set_features, use_mainnet_config, use_minimal_config,
+)
+from prysm_tpu.crypto.bls import bls
+from prysm_tpu.runtime import faults
+from prysm_tpu.runtime.scenarios import run_soak
+
+
+@pytest.fixture(scope="module", autouse=True)
+def minimal_xla():
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    yield
+    set_features(bls_implementation="pure")
+    use_mainnet_config()
+
+
+@pytest.fixture(autouse=True)
+def pristine_breaker():
+    bls.fused_breaker.reset()
+    yield
+    bls.fused_breaker.reset()
+
+
+def _assert_healthy(report: dict, n_slots: int) -> None:
+    """The soak acceptance contract, shared by smoke and full runs."""
+    assert report["slots"] == n_slots and not report["partial"]
+    # ZERO divergence from the golden model, ever — scheduler verdicts
+    # AND per-entry bisection/fallback verdicts
+    assert report["divergences"] == []
+    # a clean drain-then-close leaves nothing fail-closed
+    assert report["fail_closed_abandons"] == 0
+    # >= 1 full breaker trip -> probe -> recover cycle under the storm
+    assert report["breaker"]["trips"] >= 1, report["breaker"]
+    assert report["breaker"]["probes"] >= 1, report["breaker"]
+    assert report["breaker"]["resets"] >= 1, report["breaker"]
+    assert report["breaker"]["saw_open"]
+    # bounded fallback rate: pure fallbacks happen only under duress
+    # (storm window / open breaker), at most a small constant per
+    # duress slot (megabatch + per-slot retries + probes)
+    assert report["slots_under_duress"] >= 1
+    assert (report["degraded_dispatches"]
+            <= 2 * report["slots_under_duress"]), report
+    # the scenario generators actually ran, and cleanly
+    sc = report["scenarios"]
+    assert sc["reorgs"] >= 1 and sc["reorg_violations"] == []
+    assert sc["slashing_detections"] >= 1
+    assert sc["slashing_pool_inserts"] >= 1
+    assert sc["churn_appends"] >= 1 and sc["churn_violations"] == []
+    # poisoning outside the storm was settled by ON-DEVICE bisection
+    assert report["megabatch_bisects"] >= 1
+    assert report["bisection_isolations"] >= 1
+
+
+def test_soak_smoke_64_slots_mixed_schedule():
+    """Tier-1 smoke: 64 slots under the full mixed fault + scenario
+    schedule (storm window ~slots 16-28)."""
+    with faults.inject():   # shield from any env chaos schedule:
+        report = run_soak(n_slots=64, seed=1337)
+    _assert_healthy(report, 64)
+
+
+def test_soak_is_deterministic_for_a_seed():
+    """Same seed -> byte-identical decision stream: the report's
+    counters must match run-for-run (this is what makes a soak
+    failure reproducible from its seed alone)."""
+    with faults.inject():
+        a = run_soak(n_slots=48, seed=99)
+        b = run_soak(n_slots=48, seed=99)
+    for k in ("divergences", "breaker", "fail_closed_abandons",
+              "degraded_dispatches", "slots_under_duress",
+              "megabatch_bisects", "bisection_isolations",
+              "megabatch_demotions", "scenarios"):
+        assert a[k] == b[k], k
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+def test_soak_full_2048_slots():
+    """The long soak (make soak): thousands of slots, same contract.
+    Excluded from tier-1 (soak + slow markers); the bench `soak` tier
+    runs the same harness with a wall deadline."""
+    with faults.inject():
+        report = run_soak(n_slots=2048, seed=1337)
+    _assert_healthy(report, 2048)
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+def test_soak_deadline_reports_partial():
+    """A soak that outruns its wall budget stops cleanly, flags the
+    report PARTIAL, and still shows zero divergence/abandons."""
+    with faults.inject():
+        report = run_soak(n_slots=100_000, seed=7, deadline_s=20.0)
+    assert report["partial"]
+    assert 0 < report["slots"] < 100_000
+    assert report["divergences"] == []
+    assert report["fail_closed_abandons"] == 0
