@@ -7,13 +7,22 @@
 
 PY ?= python
 
-.PHONY: test test-slow chaos stream soak warm-cache dryrun bench native proto
+.PHONY: test test-slow lint chaos stream soak warm-cache dryrun bench native proto
 
 test:
 	$(PY) -m pytest tests/ -x -q
 
 test-slow:
 	$(PY) -m pytest tests/ -q -m slow
+
+# Static-analysis gate (ISSUE 8): the AST lints over prysm_tpu/ +
+# bench.py (jit hazards, recompile hazards, metric/fault-seam
+# registries, dead imports) must report ZERO findings, and the
+# checkers must still catch their seeded fixture violations.  Pure
+# stdlib, no jax import — sub-second.
+lint:
+	$(PY) -m prysm_tpu.analysis
+	$(PY) -m pytest tests/test_analysis.py tests/test_lockcheck.py -q
 
 # Chaos gate: the tier-1 suite under a SEEDED fault schedule (runtime/
 # faults.py) — every verdict must still match the golden model via the

@@ -765,17 +765,12 @@ def main() -> None:
             # megabatch counters expose the scheduler's decisions the
             # same way (every flush/bisect/demotion is a metric).
             from prysm_tpu.monitoring.metrics import metrics as _m
+            from prysm_tpu.monitoring.registry import BENCH_STAMPED
 
             result["degraded_dispatches"] = \
                 _m.counter("degraded_dispatches").value
             result["breaker_trips"] = _m.counter("breaker_trips").value
-            for mname in ("megabatch_slots_dispatched",
-                          "megabatch_dispatches", "megabatch_retries",
-                          "megabatch_bisects", "megabatch_demotions",
-                          "bisection_device_verifies",
-                          "bisection_isolations", "fail_closed_abandons",
-                          "reorgs_applied", "slashings_injected",
-                          "registry_churn_events", "soak_slots"):
+            for mname in BENCH_STAMPED:
                 v = _m.counter(mname).value
                 if v:
                     result[mname] = v

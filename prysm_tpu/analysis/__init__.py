@@ -1,0 +1,36 @@
+"""Static analysis + runtime sanitizers for the prysm_tpu tree.
+
+Two halves (see ISSUE 8 / README "Static analysis"):
+
+* :mod:`astlint` — pure-AST checkers (jit hazards, recompile hazards,
+  metric/fault-seam registries, dead imports) run by ``make lint``,
+  ``python -m prysm_tpu.analysis`` and the tier-1
+  ``tests/test_analysis.py`` tree scan.  No jax import — the lint
+  gate stays sub-second.
+* :mod:`lockcheck` / :mod:`transfer` — runtime sanitizers: TSan-lite
+  instrumented locks with a lock-order-inversion detector and a
+  deterministic interleaving fuzzer for the threaded dispatch layer,
+  and a ``jax.transfer_guard`` host-sync sanitizer scoped around the
+  fused slot-verify dispatch.
+"""
+
+from .astlint import (
+    Checker, DeadImportChecker, FaultSeamChecker, Finding,
+    JitHazardChecker, MetricsRegistryChecker, RecompileHazardChecker,
+    default_checkers, iter_tree_files, run_checkers, run_tree,
+)
+from .lockcheck import (
+    InstrumentedLock, LockMonitor, guard_fields, instrument,
+    interleave_fuzz,
+)
+from .transfer import dispatch_guard, host_sync_guard, sanitize_enabled
+
+__all__ = [
+    "Checker", "DeadImportChecker", "FaultSeamChecker", "Finding",
+    "InstrumentedLock", "JitHazardChecker", "LockMonitor",
+    "MetricsRegistryChecker", "RecompileHazardChecker",
+    "default_checkers", "dispatch_guard", "guard_fields",
+    "host_sync_guard", "instrument", "interleave_fuzz",
+    "iter_tree_files", "run_checkers", "run_tree",
+    "sanitize_enabled",
+]

@@ -1,0 +1,27 @@
+"""``python -m prysm_tpu.analysis`` — run the full AST lint gate over
+the tree (prysm_tpu/ + bench.py) and exit nonzero on any finding.
+
+This is what ``make lint`` calls.  It deliberately never imports jax:
+the gate must stay fast enough to run on every commit.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .astlint import run_tree
+
+
+def main() -> int:
+    findings = run_tree()
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"\n{len(findings)} finding(s).", file=sys.stderr)
+        return 1
+    print("analysis: clean tree (0 findings)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

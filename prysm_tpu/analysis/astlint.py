@@ -1,0 +1,674 @@
+"""Project-specific AST lints for the prysm_tpu tree.
+
+A training stack gets ``-race``, sanitizers, and compile-time shape
+checks; a consensus stack living on the same hardware deserves no
+less.  These checkers encode the invariants four PRs of concurrency
+and fused-dispatch work left implicit:
+
+* :class:`JitHazardChecker` — Python control flow, host casts, host
+  transfers, and nondeterminism inside ``@jax.jit``-traced functions.
+  A ``bool()`` on a traced value is a silent device sync in the hot
+  path; ``time.time()`` inside a traced function bakes trace-time
+  values into the compiled graph; both also poison the pure-golden
+  BLS model's determinism.
+* :class:`RecompileHazardChecker` — call sites that bypass the
+  bucket-padded stable-shape dispatch helpers or pass
+  retrace-per-element / unhashable arguments to jitted entry points.
+  One unpadded shape recompiles a multi-second XLA graph mid-slot.
+* :class:`MetricsRegistryChecker` — every metric name used anywhere
+  (including bench.py's tier-JSON stamping) must be declared in
+  ``monitoring/registry.py`` with the right kind, and every declared
+  name must be used: a typo'd counter silently mints a forever-zero
+  twin, and a dead declaration is a lie in the scrape surface.
+* :class:`FaultSeamChecker` — every fault-injection point fired must
+  be registered in ``runtime/faults.py`` and every registered point
+  must be fired somewhere: an unregistered seam can never be
+  scheduled, a dead seam gives chaos coverage that tests nothing.
+* :class:`DeadImportChecker` — unused imports and unreferenced
+  module-private definitions (pure-Python sweep, no third-party
+  linter).
+
+Every checker is exercised by fixture files under
+``analysis/fixtures/`` (seeded true positives) and by the tier-1
+tree scan (zero findings on the clean tree) — see
+``tests/test_analysis.py`` and ``make lint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+#: absolute path of the prysm_tpu package root
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: absolute path of the repository root (holds bench.py)
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str
+    path: str      # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+def iter_tree_files(extra: tuple[str, ...] = ("bench.py",)):
+    """Yield (repo-relative path, source text) for every scanned file:
+    the whole ``prysm_tpu/`` package plus ``extra`` top-level files.
+    ``analysis/fixtures/`` (seeded violations) and ``__pycache__`` are
+    excluded."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(PKG_ROOT):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        rel = os.path.relpath(dirpath, REPO_ROOT)
+        if rel.replace(os.sep, "/").startswith(
+                "prysm_tpu/analysis/fixtures"):
+            dirnames[:] = []
+            continue
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    for fn in extra:
+        p = os.path.join(REPO_ROOT, fn)
+        if os.path.exists(p):
+            out.append(p)
+    for p in out:
+        with open(p, "r", encoding="utf-8") as f:
+            yield os.path.relpath(p, REPO_ROOT), f.read()
+
+
+def run_checkers(checkers, files=None) -> list[Finding]:
+    """Parse each file once, feed every checker, then finalize.
+    ``files`` is an iterable of (relpath, source); default: the tree."""
+    if files is None:
+        files = iter_tree_files()
+    for relpath, src in files:
+        try:
+            tree = ast.parse(src, filename=relpath)
+        except SyntaxError as e:
+            return [Finding("parse", relpath, e.lineno or 0,
+                            f"syntax error: {e.msg}")]
+        for c in checkers:
+            c.visit_module(relpath, tree)
+    findings: list[Finding] = []
+    for c in checkers:
+        findings.extend(c.finalize())
+    return sorted(findings, key=lambda f: (f.path, f.line, f.checker))
+
+
+class Checker:
+    name = "base"
+
+    def visit_module(self, path: str, tree: ast.Module) -> None:
+        raise NotImplementedError
+
+    def finalize(self) -> list[Finding]:
+        return []
+
+
+# --- shared AST helpers -----------------------------------------------------
+
+
+def dotted(node) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _jit_decoration(dec):
+    """(is_jit, static_argnums, static_argnames) for one decorator
+    expression, recognizing ``@jax.jit``, ``@jit``,
+    ``@partial(jax.jit, ...)`` / ``@functools.partial(jax.jit, ...)``
+    and the call form ``@jax.jit(...)``."""
+    d = dotted(dec)
+    if d in ("jax.jit", "jit"):
+        return True, (), ()
+    if isinstance(dec, ast.Call):
+        f = dotted(dec.func)
+        inner = dotted(dec.args[0]) if dec.args else None
+        if f in ("partial", "functools.partial") and inner in (
+                "jax.jit", "jit"):
+            return True, *_static_kwargs(dec.keywords)
+        if f in ("jax.jit", "jit"):
+            return True, *_static_kwargs(dec.keywords)
+    return False, (), ()
+
+
+def _static_kwargs(keywords):
+    nums: tuple[int, ...] = ()
+    names: tuple[str, ...] = ()
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            nums = tuple(_const_ints(kw.value))
+        elif kw.arg == "static_argnames":
+            names = tuple(_const_strs(kw.value))
+    return nums, names
+
+
+def _const_ints(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return []
+
+
+def _const_strs(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def find_jit_functions(tree: ast.Module):
+    """{name: (FunctionDef, static_param_names)} for every function the
+    module jits — by decorator, or by a ``jax.jit(fn)`` call anywhere
+    (the named-entry pattern ``return jax.jit(pipeline)``)."""
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    jitted = {}
+    for fn in defs.values():
+        for dec in fn.decorator_list:
+            is_jit, nums, names = _jit_decoration(dec)
+            if is_jit:
+                params = [a.arg for a in fn.args.posonlyargs
+                          + fn.args.args]
+                static = {params[i] for i in nums if i < len(params)}
+                static.update(names)
+                jitted[fn.name] = (fn, static)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and dotted(node.func) in ("jax.jit", "jit")
+                and node.args and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in defs
+                and node.args[0].id not in jitted):
+            nums, names = _static_kwargs(node.keywords)
+            fn = defs[node.args[0].id]
+            params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+            static = {params[i] for i in nums if i < len(params)}
+            static.update(names)
+            jitted[fn.name] = (fn, static)
+    return defs, jitted
+
+
+# --- jit-hazard checker -----------------------------------------------------
+
+#: attribute reads that yield STATIC (trace-time) values — branching
+#: on them specializes the graph legitimately
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding"})
+#: calls whose result is static regardless of argument taint
+STATIC_CALLS = frozenset({"len", "isinstance", "type", "hasattr",
+                          "getattr", "id", "repr", "str"})
+#: host-sync casts: forcing a traced value to a Python scalar blocks
+#: on the device and (under jit tracing) raises ConcretizationError
+HOST_CASTS = frozenset({"bool", "int", "float", "complex"})
+#: nondeterminism sources: illegal inside traced graphs AND inside the
+#: pure-golden BLS model (crypto/bls/pure)
+NONDET_EXACT = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.time_ns", "os.urandom",
+})
+NONDET_PREFIXES = ("random.", "np.random.", "numpy.random.",
+                   "secrets.", "uuid.uuid")
+#: modules whose whole file is held to the golden-determinism rule
+GOLDEN_PREFIXES = ("prysm_tpu/crypto/bls/pure/",)
+
+
+def _is_nondet(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    if d is None:
+        return False
+    return d in NONDET_EXACT or d.startswith(NONDET_PREFIXES)
+
+
+class _TaintScan(ast.NodeVisitor):
+    """One fixpoint pass propagating taint (traced-value reachability)
+    through simple assignments; static extractors stop taint."""
+
+    def __init__(self, taint: set[str]):
+        self.taint = taint
+
+    def tainted_expr(self, node) -> bool:
+        """True when ``node`` references a tainted name OUTSIDE any
+        static extractor (``x.shape``, ``len(x)``, ``isinstance``) —
+        those yield trace-time constants, so branching on them merely
+        specializes the graph."""
+        found = False
+
+        def walk(n, shielded):
+            nonlocal found
+            if found:
+                return
+            if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+                shielded = True
+            elif isinstance(n, ast.Call) and \
+                    dotted(n.func) in STATIC_CALLS:
+                shielded = True
+            if isinstance(n, ast.Name) and not shielded \
+                    and n.id in self.taint:
+                found = True
+                return
+            for child in ast.iter_child_nodes(n):
+                walk(child, shielded)
+
+        walk(node, False)
+        return found
+
+
+class JitHazardChecker(Checker):
+    name = "jit-hazard"
+
+    def __init__(self):
+        self._findings: list[Finding] = []
+
+    def visit_module(self, path: str, tree: ast.Module) -> None:
+        defs, jitted = find_jit_functions(tree)
+        # reachable helpers: same-module functions called (by name)
+        # from a jitted body, transitively — checked for
+        # nondeterminism only (their params' static-ness is unknown)
+        reachable: set[str] = set()
+        frontier = [fn for fn, _s in jitted.values()]
+        while frontier:
+            fn = frontier.pop()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name):
+                    callee = node.func.id
+                    if callee in defs and callee not in jitted \
+                            and callee not in reachable:
+                        reachable.add(callee)
+                        frontier.append(defs[callee])
+        for name, (fn, static) in jitted.items():
+            self._check_traced(path, fn, static, full=True)
+        for name in reachable:
+            self._check_traced(path, defs[name], set(), full=False)
+        if path.replace(os.sep, "/").startswith(GOLDEN_PREFIXES):
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call) and _is_nondet(node):
+                    self._findings.append(Finding(
+                        self.name, path, node.lineno,
+                        f"nondeterminism ({dotted(node.func)}) in "
+                        f"pure-golden BLS code"))
+
+    def _check_traced(self, path, fn, static, full):
+        a = fn.args
+        params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+        taint = set(params) - set(static)
+        scan = _TaintScan(taint)
+        # fixpoint over simple assignments
+        for _ in range(16):
+            before = len(taint)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and scan.tainted_expr(
+                        node.value):
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                taint.add(n.id)
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                        node.target, ast.Name):
+                    if scan.tainted_expr(node.value) or \
+                            node.target.id in taint:
+                        taint.add(node.target.id)
+                elif isinstance(node, (ast.For,)) and scan.tainted_expr(
+                        node.iter):
+                    for n in ast.walk(node.target):
+                        if isinstance(n, ast.Name):
+                            taint.add(n.id)
+            if len(taint) == before:
+                break
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if _is_nondet(node):
+                    self._findings.append(Finding(
+                        self.name, path, node.lineno,
+                        f"nondeterminism ({dotted(node.func)}) inside "
+                        f"jit-traced {fn.name!r} — trace-time value "
+                        f"baked into the compiled graph"))
+                    continue
+                if not full:
+                    continue
+                f = dotted(node.func)
+                if f in HOST_CASTS and any(
+                        scan.tainted_expr(arg) for arg in node.args):
+                    self._findings.append(Finding(
+                        self.name, path, node.lineno,
+                        f"{f}() on a traced value inside jitted "
+                        f"{fn.name!r} — implicit device sync "
+                        f"(ConcretizationError under trace)"))
+                elif f in ("np.asarray", "np.array", "numpy.asarray",
+                           "numpy.array") and any(
+                        scan.tainted_expr(arg) for arg in node.args):
+                    self._findings.append(Finding(
+                        self.name, path, node.lineno,
+                        f"{f}() on a traced value inside jitted "
+                        f"{fn.name!r} — host transfer in the traced "
+                        f"graph"))
+            elif full and isinstance(node, (ast.If, ast.While)):
+                if scan.tainted_expr(node.test):
+                    kind = "while" if isinstance(node, ast.While) \
+                        else "if"
+                    self._findings.append(Finding(
+                        self.name, path, node.lineno,
+                        f"python `{kind}` on a traced value inside "
+                        f"jitted {fn.name!r} — use lax.cond/select; "
+                        f"data-dependent control flow cannot trace"))
+
+    def finalize(self) -> list[Finding]:
+        return self._findings
+
+
+# --- recompile-hazard checker -----------------------------------------------
+
+#: jit entries that REQUIRE the bucket-padded packing path — calling
+#: them raw from service code bypasses stable-shape dispatch and
+#: recompiles per committee-count
+RESTRICTED_ENTRIES = {
+    "fused_slot_verify_device": (
+        "prysm_tpu/crypto/bls/", "prysm_tpu/operations/attestations.py"),
+    "indexed_slot_verify_device": (
+        "prysm_tpu/crypto/bls/", "prysm_tpu/operations/attestations.py"),
+}
+
+
+class RecompileHazardChecker(Checker):
+    name = "recompile-hazard"
+
+    def __init__(self):
+        self._jitted: dict[str, set[str]] = {}   # name -> static names
+        self._static_pos: dict[str, set[int]] = {}
+        self._calls: list[tuple[str, ast.Call]] = []
+        self._findings: list[Finding] = []
+
+    def visit_module(self, path: str, tree: ast.Module) -> None:
+        _defs, jitted = find_jit_functions(tree)
+        for name, (fn, static) in jitted.items():
+            self._jitted.setdefault(name, set()).update(static)
+            params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+            self._static_pos.setdefault(name, set()).update(
+                i for i, p in enumerate(params) if p in static)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                self._calls.append((path, node))
+
+    def finalize(self) -> list[Finding]:
+        for path, call in self._calls:
+            d = dotted(call.func)
+            if d is None:
+                continue
+            callee = d.rsplit(".", 1)[-1]
+            if callee not in self._jitted:
+                continue
+            norm = path.replace(os.sep, "/")
+            allowed = RESTRICTED_ENTRIES.get(callee)
+            if allowed is not None and not norm.startswith(allowed):
+                self._findings.append(Finding(
+                    self.name, path, call.lineno,
+                    f"direct call to {callee} bypasses the "
+                    f"bucket-padded dispatch helpers (use "
+                    f"IndexedSlotBatch / the stream scheduler)"))
+            statics = self._static_pos.get(callee, set())
+            static_names = self._jitted.get(callee, set())
+            for i, arg in enumerate(call.args):
+                if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    if i in statics:
+                        self._findings.append(Finding(
+                            self.name, path, arg.lineno,
+                            f"unhashable {type(arg).__name__.lower()} "
+                            f"literal as static arg {i} of jitted "
+                            f"{callee} — jit raises / retraces"))
+                    else:
+                        self._findings.append(Finding(
+                            self.name, path, arg.lineno,
+                            f"{type(arg).__name__.lower()} literal "
+                            f"passed to jitted {callee} — traced as a "
+                            f"pytree of scalars, retraces per length"))
+            for kw in call.keywords:
+                if kw.arg in static_names and isinstance(
+                        kw.value, (ast.List, ast.Dict, ast.Set)):
+                    self._findings.append(Finding(
+                        self.name, path, kw.value.lineno,
+                        f"unhashable literal for static arg "
+                        f"{kw.arg!r} of jitted {callee}"))
+        return self._findings
+
+
+# --- metrics-registry checker -----------------------------------------------
+
+_METRIC_METHODS = {
+    "inc": "counter", "counter": "counter",
+    "observe": "histogram", "histogram": "histogram",
+    "set": "gauge", "gauge": "gauge",
+}
+
+
+class MetricsRegistryChecker(Checker):
+    name = "metrics-registry"
+
+    def __init__(self, declared: dict[str, tuple[str, str]] | None = None,
+                 stamped: tuple[str, ...] | None = None):
+        if declared is None:
+            from ..monitoring.registry import BENCH_STAMPED, METRICS
+            declared, stamped = METRICS, BENCH_STAMPED
+        self._declared = declared
+        self._stamped = stamped or ()
+        self._used: set[str] = set()
+        self._findings: list[Finding] = []
+
+    def visit_module(self, path: str, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS
+                    and node.args):
+                continue
+            kind = _METRIC_METHODS[node.func.attr]
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str):
+                self._check_use(path, node.lineno, arg.value, kind)
+            elif isinstance(arg, ast.JoinedStr):
+                prefix = ""
+                for v in arg.values:
+                    if isinstance(v, ast.Constant):
+                        prefix += str(v.value)
+                    else:
+                        break
+                self._check_family(path, node.lineno, prefix, kind)
+
+    def _check_use(self, path, line, name, kind) -> None:
+        self._used.add(name)
+        decl = self._declared.get(name)
+        if decl is None:
+            self._findings.append(Finding(
+                self.name, path, line,
+                f"metric {name!r} is not declared in "
+                f"monitoring/registry.py (typo mints a forever-zero "
+                f"twin)"))
+        elif decl[0] != kind:
+            self._findings.append(Finding(
+                self.name, path, line,
+                f"metric {name!r} used as {kind} but declared "
+                f"{decl[0]}"))
+
+    def _check_family(self, path, line, prefix, kind) -> None:
+        if not prefix:
+            return   # fully dynamic name: nothing checkable
+        members = [n for n in self._declared if n.startswith(prefix)]
+        if not members:
+            self._findings.append(Finding(
+                self.name, path, line,
+                f"dynamic metric family {prefix!r}* has no declared "
+                f"members in monitoring/registry.py"))
+            return
+        for n in members:
+            self._used.add(n)
+            if self._declared[n][0] != kind:
+                self._findings.append(Finding(
+                    self.name, path, line,
+                    f"family member {n!r} used as {kind} but "
+                    f"declared {self._declared[n][0]}"))
+
+    def finalize(self) -> list[Finding]:
+        self._used.update(self._stamped)
+        for name in sorted(set(self._declared) - self._used):
+            self._findings.append(Finding(
+                self.name, "prysm_tpu/monitoring/registry.py", 0,
+                f"declared metric {name!r} is never used anywhere in "
+                f"the tree (dead metric)"))
+        return self._findings
+
+
+# --- fault-seam checker -----------------------------------------------------
+
+
+class FaultSeamChecker(Checker):
+    name = "fault-seam"
+
+    #: file whose module-level ``_POINTS`` tuple declares the seams
+    REGISTRY_PATH = "prysm_tpu/runtime/faults.py"
+
+    def __init__(self, registered: tuple[str, ...] | None = None):
+        self._registered = registered
+        self._reg_line = 0
+        self._fired: dict[str, tuple[str, int]] = {}
+        self._findings: list[Finding] = []
+
+    def visit_module(self, path: str, tree: ast.Module) -> None:
+        norm = path.replace(os.sep, "/")
+        if norm == self.REGISTRY_PATH and self._registered is None:
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "_POINTS"
+                        for t in node.targets):
+                    self._registered = tuple(_const_strs(node.value))
+                    self._reg_line = node.lineno
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            f = node.func
+            is_fire = (isinstance(f, ast.Name) and f.id == "fire") or (
+                isinstance(f, ast.Attribute) and f.attr == "fire"
+                and isinstance(f.value, ast.Name)
+                and f.value.id.endswith("faults"))
+            if not is_fire:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str):
+                self._fired.setdefault(arg.value, (path, node.lineno))
+
+    def finalize(self) -> list[Finding]:
+        registered = self._registered or ()
+        for name, (path, line) in sorted(self._fired.items()):
+            if name not in registered:
+                self._findings.append(Finding(
+                    self.name, path, line,
+                    f"injection point {name!r} fired but not "
+                    f"registered in runtime/faults._POINTS — it can "
+                    f"never be scheduled"))
+        for name in registered:
+            if name not in self._fired:
+                self._findings.append(Finding(
+                    self.name, self.REGISTRY_PATH, self._reg_line,
+                    f"registered injection point {name!r} is never "
+                    f"fired anywhere (dead seam — chaos coverage that "
+                    f"tests nothing)"))
+        return self._findings
+
+
+# --- dead-import / unused-symbol checker ------------------------------------
+
+
+class DeadImportChecker(Checker):
+    name = "dead-import"
+
+    #: file patterns exempt from the sweep: __init__.py files are
+    #: re-export surfaces; generated protobuf modules are not ours
+    def _exempt(self, path: str) -> bool:
+        base = os.path.basename(path)
+        return base == "__init__.py" or base.endswith("_pb2.py")
+
+    def __init__(self):
+        self._findings: list[Finding] = []
+        # module-private top-level defs: name -> (path, line); usage
+        # is module-local by definition, so resolved per module
+        self._private: list[Finding] = []
+
+    def visit_module(self, path: str, tree: ast.Module) -> None:
+        if self._exempt(path):
+            return
+        bound: dict[str, int] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    bound[al.asname or al.name.split(".")[0]] = \
+                        node.lineno
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for al in node.names:
+                    if al.name != "*":
+                        bound[al.asname or al.name] = node.lineno
+        used: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Constant) and isinstance(
+                    node.value, str):
+                # __all__ entries, getattr-by-string, doctests
+                used.add(node.value)
+        for name, line in sorted(bound.items(),
+                                 key=lambda kv: (kv[1], kv[0])):
+            if name not in used:
+                self._findings.append(Finding(
+                    self.name, path, line,
+                    f"import {name!r} is never used"))
+        # unreferenced module-private top-level functions/classes
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                n = node.name
+                if not n.startswith("_") or n.startswith("__"):
+                    continue
+                refs = sum(1 for m in ast.walk(tree)
+                           if isinstance(m, ast.Name) and m.id == n)
+                if refs == 0 and n not in used:
+                    self._findings.append(Finding(
+                        self.name, path, node.lineno,
+                        f"module-private {n!r} is defined but never "
+                        f"referenced"))
+
+    def finalize(self) -> list[Finding]:
+        return self._findings
+
+
+def default_checkers() -> list[Checker]:
+    """The full gate, wired to the real declared registries."""
+    return [JitHazardChecker(), RecompileHazardChecker(),
+            MetricsRegistryChecker(), FaultSeamChecker(),
+            DeadImportChecker()]
+
+
+def run_tree() -> list[Finding]:
+    """Run the full gate over the tree (what `make lint` and the
+    tier-1 test call)."""
+    return run_checkers(default_checkers())

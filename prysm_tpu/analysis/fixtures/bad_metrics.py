@@ -1,0 +1,14 @@
+"""SEEDED VIOLATIONS for MetricsRegistryChecker — parsed, never
+imported."""
+
+
+def emit(metrics, reason):
+    # metrics-registry: typo'd counter (declared name is
+    # 'fail_closed_abandons') mints a forever-zero twin
+    metrics.inc("fail_closed_abandonments")
+    # metrics-registry: declared as a counter, used as a gauge
+    metrics.set("fail_closed_abandons", 1)
+    # metrics-registry: dynamic family with no declared members
+    metrics.inc(f"nonexistent_family_{reason}")
+    # NOT a finding: declared counter used with the right kind
+    metrics.inc("dispatch_resubmits")

@@ -1,0 +1,14 @@
+"""SEEDED VIOLATIONS for FaultSeamChecker — parsed, never imported.
+
+The test feeds this file together with a fake registry declaring
+``("readback", "never_fired_seam")``: firing an unregistered point
+and leaving a registered one dead are both findings."""
+
+from prysm_tpu.runtime import faults as _faults
+
+
+def chaos_path(value):
+    # fault-seam: fired but not registered in runtime/faults._POINTS
+    _faults.fire("totally_unregistered_seam", value)
+    # NOT a finding (registered and fired)
+    return _faults.fire("readback", value)

@@ -1,0 +1,17 @@
+"""SEEDED VIOLATIONS for DeadImportChecker — parsed, never imported."""
+
+import os
+import struct            # dead-import: never used
+from collections import OrderedDict, defaultdict   # OrderedDict unused
+
+
+def _used_helper():
+    return os.getpid()
+
+
+def _dead_helper():      # dead-import: module-private, never referenced
+    return defaultdict(int)
+
+
+def entry():
+    return _used_helper()

@@ -1,0 +1,53 @@
+"""SEEDED VIOLATIONS for JitHazardChecker — never imported, only
+parsed by tests/test_analysis.py.  Excluded from the tree scan."""
+
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def branch_on_traced(x):
+    if x > 0:                      # jit-hazard: python `if` on traced
+        return x
+    return -x
+
+
+@jax.jit
+def while_on_traced(x):
+    while x < 10:                  # jit-hazard: python `while` on traced
+        x = x + 1
+    return x
+
+
+@jax.jit
+def host_cast(x):
+    return bool(x)                 # jit-hazard: host-sync cast
+
+
+@jax.jit
+def host_transfer(x):
+    return np.asarray(x)           # jit-hazard: host transfer in graph
+
+
+@jax.jit
+def trace_time_clock(x):
+    return x + time.time()         # jit-hazard: nondeterminism baked in
+
+
+def helper_with_clock(x):
+    return x * time.monotonic()    # jit-hazard: reachable from jitted
+
+
+@jax.jit
+def calls_helper(x):
+    return helper_with_clock(x)
+
+
+@jax.jit
+def clean_shape_branch(x):
+    # NOT a finding: .shape is a trace-time constant
+    if x.shape[0] > 4:
+        return x[:4]
+    return x

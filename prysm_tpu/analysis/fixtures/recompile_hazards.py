@@ -1,0 +1,39 @@
+"""SEEDED VIOLATIONS for RecompileHazardChecker — parsed, never
+imported.  The jitted stand-ins here shadow nothing: the checker is
+fed this file alone, so callee-name resolution happens against the
+fixture's own jit table."""
+
+import jax
+
+
+@jax.jit
+def local_jitted(xs, n):
+    return xs[:n]
+
+
+def jit_with_statics():
+    return jax.jit(padded_kernel, static_argnums=(1,))
+
+
+def padded_kernel(xs, bucket):
+    return xs
+
+
+@jax.jit
+def fused_slot_verify_device(xs):
+    """Stand-in for the restricted fused entry; the checker flags the
+    CALL below because this fixture poses as service code outside the
+    crypto/bls dispatch layer."""
+    return xs
+
+
+def bad_callers(xs):
+    # recompile-hazard: list literal traced as pytree of scalars,
+    # retraces per length
+    a = local_jitted([1, 2, 3], 3)
+    # recompile-hazard: unhashable list literal at static position 1
+    b = padded_kernel(xs, [4, 5])
+    # recompile-hazard: restricted entry called outside the bls/
+    # dispatch layer (bypasses bucket-padded packing)
+    c = fused_slot_verify_device(xs)
+    return a, b, c

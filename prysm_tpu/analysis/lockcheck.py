@@ -1,0 +1,234 @@
+"""TSan-lite runtime lock sanitizer for the threaded dispatch layer.
+
+The concurrency surface built across PRs 1-7 — ``SlotDispatcher``
+(double-buffered tickets, fail-closed ``abandon``/``close``),
+``StreamScheduler`` (megabatch accumulation under an ``RLock``) and
+``MegabatchAccumulator`` (not thread-safe by contract, serialized
+under the scheduler's lock) — has exactly one cross-object lock
+order: scheduler -> dispatcher (``StreamScheduler.close`` holds its
+own lock while calling ``SlotDispatcher.close``).  Nothing may ever
+acquire them the other way round, and nothing may mutate the
+dispatcher's or accumulator's shared fields without the owning lock.
+
+This module enforces both at runtime, without touching production
+code paths:
+
+* :class:`LockMonitor` + :class:`InstrumentedLock` — wrap the
+  ``_lock`` attribute of live objects (:func:`instrument`), record a
+  per-thread held-lock stack and the global acquisition-order graph
+  (edges ``held -> acquiring``), and report a **lock-order
+  inversion** the moment the reverse edge of an existing edge is
+  observed — the classic TSan deadlock predictor: it fires on the
+  *potential* deadlock ordering even when the timing happened to be
+  safe this run.
+* :func:`guard_fields` — a mutation sentinel: rebinds the object's
+  class to a dynamic subclass whose ``__setattr__`` reports any write
+  to a guarded field while the owning lock is not held by the writing
+  thread (unguarded shared-state mutation).
+* :func:`interleave_fuzz` — a deterministic interleaving fuzzer:
+  a seeded RNG assigns operations (``close``/``abandon``/
+  ``resubmit``/...) to worker threads and injects seeded yield points
+  between them, so a given seed explores the same contention schedule
+  on every run and a failing seed is replayable.
+
+Used by ``tests/test_lockcheck.py``: fixture tests prove the detector
+catches a seeded inversion and a seeded unguarded write, and the
+tier-1 contention fuzzer re-runs the PR-7 concurrent
+``close()``/``abandon()`` exactly-once scenario under instrumented
+locks, asserting zero violations on the clean tree.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+class LockMonitor:
+    """Records lock acquisition order across threads and collects
+    violations.  One monitor per test/fuzz run; locks registered on
+    it share one order graph."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._mu = threading.Lock()
+        #: ordered pair (held.name, acquiring.name) -> first thread name
+        self._edges: dict[tuple[str, str], str] = {}
+        #: human-readable violation reports, in detection order
+        self.violations: list[str] = []
+
+    # -- per-thread held stack ------------------------------------------------
+
+    def _held(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def holds(self, lock) -> bool:
+        """True when the calling thread currently holds ``lock``."""
+        return any(h is lock for h in self._held())
+
+    # -- events from InstrumentedLock -----------------------------------------
+
+    def on_attempt(self, lock) -> None:
+        """Called BEFORE the blocking acquire: records order edges so a
+        potential deadlock is reported even if this run would hang."""
+        held = self._held()
+        tname = threading.current_thread().name
+        with self._mu:
+            for h in held:
+                if h is lock:       # RLock re-entry: no self-edge
+                    continue
+                edge = (h.name, lock.name)
+                rev = (lock.name, h.name)
+                if rev in self._edges:
+                    msg = (f"lock-order inversion: thread {tname!r} "
+                           f"acquires {lock.name!r} while holding "
+                           f"{h.name!r}, but thread "
+                           f"{self._edges[rev]!r} acquired them in "
+                           f"the opposite order")
+                    if msg not in self.violations:
+                        self.violations.append(msg)
+                self._edges.setdefault(edge, tname)
+
+    def on_acquired(self, lock) -> None:
+        self._held().append(lock)
+
+    def on_release(self, lock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+        self.violations.append(
+            f"release of {lock.name!r} by thread "
+            f"{threading.current_thread().name!r} that does not hold "
+            f"it")
+
+    def on_unguarded_write(self, label: str, field: str,
+                           lock) -> None:
+        self.violations.append(
+            f"unguarded mutation: {label}.{field} written by thread "
+            f"{threading.current_thread().name!r} without holding "
+            f"{lock.name!r}")
+
+    # -- reports ---------------------------------------------------------------
+
+    def inversions(self) -> list[str]:
+        return [v for v in self.violations if "inversion" in v]
+
+    def edges(self) -> dict[tuple[str, str], str]:
+        with self._mu:
+            return dict(self._edges)
+
+
+class InstrumentedLock:
+    """Drop-in wrapper for ``threading.Lock``/``RLock`` reporting to a
+    :class:`LockMonitor`.  Supports the context-manager protocol and
+    explicit acquire/release, which is all the dispatch layer uses."""
+
+    def __init__(self, inner, name: str, monitor: LockMonitor):
+        self._inner = inner
+        self.name = name
+        self._mon = monitor
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._mon.on_attempt(self)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._mon.on_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        self._mon.on_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def instrument(monitor: LockMonitor, **named) -> dict[str, InstrumentedLock]:
+    """Replace each object's ``_lock`` with an :class:`InstrumentedLock`
+    named after its keyword (``instrument(mon, dispatcher=disp,
+    scheduler=sched)``).  Returns name -> wrapper.  Idempotent per
+    object: re-instrumenting wraps the original inner lock, not the
+    wrapper."""
+    out: dict[str, InstrumentedLock] = {}
+    for name, obj in named.items():
+        inner = obj._lock
+        if isinstance(inner, InstrumentedLock):
+            inner = inner._inner
+        wrapper = InstrumentedLock(inner, name, monitor)
+        obj._lock = wrapper
+        out[name] = wrapper
+    return out
+
+
+def guard_fields(obj, lock, fields, monitor: LockMonitor,
+                 label: str | None = None):
+    """Mutation sentinel: after this call, any assignment to one of
+    ``fields`` on ``obj`` while the writing thread does not hold
+    ``lock`` is reported to ``monitor``.  Implemented by rebinding
+    ``obj.__class__`` to a dynamic subclass — production classes stay
+    untouched."""
+    base = type(obj)
+    label = label or base.__name__
+    guarded = frozenset(fields)
+
+    def __setattr__(self, name, value):
+        if name in guarded and not monitor.holds(lock):
+            monitor.on_unguarded_write(label, name, lock)
+        object.__setattr__(self, name, value)
+
+    cls = type(f"_Guarded{base.__name__}", (base,),
+               {"__setattr__": __setattr__})
+    obj.__class__ = cls
+    return obj
+
+
+def interleave_fuzz(ops, *, n_threads: int = 3, seed: int = 0,
+                    max_yields: int = 3) -> list[BaseException]:
+    """Deterministic interleaving fuzzer.
+
+    ``ops`` is a sequence of zero-arg callables.  A seeded RNG deals
+    them out to ``n_threads`` workers; all workers start together on a
+    barrier and each injects a seeded number of scheduler yields
+    before every op, so one seed explores one reproducible contention
+    schedule.  Exceptions raised by ops are collected and returned
+    (the dispatch layer's own exactly-once assertions live in the
+    ops; lock-order assertions live on the monitor)."""
+    rng = random.Random(seed)
+    buckets: list[list] = [[] for _ in range(n_threads)]
+    for op in ops:
+        buckets[rng.randrange(n_threads)].append(op)
+    barrier = threading.Barrier(n_threads)
+    errors: list[BaseException] = []
+    emu = threading.Lock()
+
+    def worker(tid: int, bucket: list) -> None:
+        r = random.Random((seed << 8) | tid)
+        barrier.wait()
+        for op in bucket:
+            for _ in range(r.randrange(max_yields + 1)):
+                # sleep(0) yields the GIL without adding wall time
+                time.sleep(0)
+            try:
+                op()
+            except BaseException as e:
+                with emu:
+                    errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t, buckets[t]),
+                                name=f"fuzz-{seed}-{t}")
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors

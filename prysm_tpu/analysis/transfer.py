@@ -1,0 +1,72 @@
+"""Host-transfer sanitizer for the fused slot-verify hot path.
+
+An implicit device<->host transfer inside the dispatch path is the
+silent performance bug this stack is built to avoid: a raw numpy
+array handed to a jitted entry, or a Python scalar mixed into a
+device expression, turns the async fused dispatch into a synchronous
+copy on every slot.  ``jax.transfer_guard`` can make those fail loudly
+— this module scopes it around exactly the region that must stay
+transfer-free: the jitted call itself, AFTER argument staging.
+
+Semantics worth knowing (verified on jax 0.4.x CPU backend, the
+tier-1 environment):
+
+* ``transfer_guard("disallow")`` blocks **implicit host->device**
+  transfers — raw ``np.ndarray`` args to a jitted function, Python
+  scalars broadcast against device arrays.  These are exactly the
+  hot-path hazards.
+* Device->host enforcement is a no-op on CPU (d2h is zero-copy
+  there), so a ``bool(verdict)`` readback is only caught on a real
+  TPU backend — the same code path enforces it there for free.
+* Compile-time constant transfers trip the guard too, so jitted
+  entries must be **warmed up outside the guard** (the tests compile
+  first, then assert the steady-state dispatch is transfer-free).
+
+Two entry points:
+
+* :func:`host_sync_guard` — unconditional guard context, used by the
+  sanitizer tests.
+* :func:`dispatch_guard` — the production wrapper around the fused
+  slot-verify dispatch in ``operations/attestations.py``; a no-op
+  unless ``PRYSM_TPU_SANITIZE`` is set, so the hot path pays nothing
+  by default and the test suite can flip the whole run into
+  sanitized mode.
+
+Neither imports jax at module import time: the AST lint gate imports
+``prysm_tpu.analysis`` and must stay jax-free and sub-second.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+#: env var: set to any non-empty value other than "0" to arm
+#: :func:`dispatch_guard` for the whole process
+SANITIZE_ENV = "PRYSM_TPU_SANITIZE"
+
+
+def sanitize_enabled() -> bool:
+    return os.environ.get(SANITIZE_ENV, "") not in ("", "0")
+
+
+@contextlib.contextmanager
+def host_sync_guard():
+    """Fail loudly on implicit host<->device transfers inside the
+    block.  Stage all arguments on device and warm up (compile) jitted
+    entries BEFORE entering."""
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def dispatch_guard():
+    """:func:`host_sync_guard` around the fused slot-verify dispatch,
+    armed only when ``PRYSM_TPU_SANITIZE`` is set."""
+    if not sanitize_enabled():
+        yield
+        return
+    with host_sync_guard():
+        yield

@@ -10,9 +10,9 @@ from __future__ import annotations
 
 from ..config import beacon_config
 from .helpers import (
-    BASE_REWARDS_PER_EPOCH, FAR_FUTURE_EPOCH, GENESIS_EPOCH,
+    BASE_REWARDS_PER_EPOCH, GENESIS_EPOCH,
     compute_activation_exit_epoch, decrease_balance,
-    get_active_validator_indices, get_attesting_indices,
+    get_attesting_indices,
     get_block_root, get_block_root_at_slot, get_current_epoch,
     get_previous_epoch, get_randao_mix, get_total_active_balance,
     get_total_balance, get_validator_churn_limit, increase_balance,

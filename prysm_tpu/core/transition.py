@@ -22,11 +22,10 @@ from ..proto import (
     Attestation, BeaconBlockHeader, DepositData, DepositMessage,
     PendingAttestation,
 )
-from ..ssz import hash_tree_root
 from . import epoch as epoch_processing
 from .helpers import (
     FAR_FUTURE_EPOCH, compute_domain, compute_epoch_at_slot,
-    compute_signing_root, get_attesting_indices, get_beacon_committee,
+    compute_signing_root, get_beacon_committee,
     get_beacon_proposer_index, get_committee_count_per_slot,
     get_current_epoch, get_domain, get_indexed_attestation,
     get_previous_epoch, get_randao_mix, increase_balance,
@@ -110,8 +109,6 @@ def process_randao(state, body, verify: bool = True) -> None:
     if verify:
         proposer = state.validators[get_beacon_proposer_index(state)]
         domain = get_domain(state, cfg.domain_randao)
-        from ..ssz import uint64
-
         root = compute_signing_root(_Uint64Box(epoch), domain)
         ok = bls.Signature.from_bytes(body.randao_reveal).verify(
             bls.PublicKey.from_bytes(proposer.pubkey), root)

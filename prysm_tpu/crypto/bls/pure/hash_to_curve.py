@@ -21,7 +21,7 @@ import hashlib
 from ....utils import xor_bytes
 from ..params import H_EFF_G2, P
 from .curve import B2, add, is_on_curve, multiply
-from .fields import Fq, Fq2
+from .fields import Fq2
 
 # --- Suite parameters -----------------------------------------------------
 

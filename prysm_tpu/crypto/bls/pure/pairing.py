@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from ..params import BLS_X_ABS, BLS_X_IS_NEGATIVE, FINAL_EXP, P, R
 from .curve import add, double, neg
-from .fields import Fq, Fq2, Fq12, V_FQ12, W_FQ12, fq12_frobenius
+from .fields import Fq, Fq12, V_FQ12, W_FQ12, fq12_frobenius
 
 _V_INV = V_FQ12.inv()
 _VW_INV = (V_FQ12 * W_FQ12).inv()

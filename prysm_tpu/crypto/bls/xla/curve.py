@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..params import (
-    G1_X, G1_Y, G2_X_C0, G2_X_C1, G2_Y_C0, G2_Y_C1, P, R,
+    G1_X, G1_Y, G2_X_C0, G2_X_C1, G2_Y_C0, G2_Y_C1, R,
 )
 from ..pure import fields as pf
 from . import lazy as Zl

@@ -38,8 +38,6 @@ y=Y/Z^3; scale factors in Fq2* dropped freely):
 
 from __future__ import annotations
 
-from functools import partial
-
 import numpy as np
 
 import jax
@@ -50,7 +48,7 @@ from ..params import BLS_X_ABS, P, R
 from . import lazy as Zl
 from . import limbs as L
 from . import tower as T
-from .curve import FQ2_OPS, _mul_many, point_double
+from .curve import _mul_many
 
 # bits of |x| after the leading 1, MSB-first (static Python constants)
 X_BITS = [int(b) for b in bin(BLS_X_ABS)[3:]]

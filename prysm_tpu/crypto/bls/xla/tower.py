@@ -25,7 +25,6 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from ..params import P
 from ..pure import fields as pf

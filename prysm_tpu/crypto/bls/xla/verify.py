@@ -22,21 +22,18 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..params import P
 from ..pure import curve as pc
-from ..pure.fields import Fq
 from . import limbs as L
 from . import tower as T
 from .curve import (
     FP_OPS, FQ2_OPS, g1_to_affine, g2_to_affine, pack_g1_points,
-    point_sum_tree, scalar_mul, scalar_mul_windowed_glv,
+    point_sum_tree, scalar_mul_windowed_glv,
     scalar_bits_from_ints, point_select, point_inf_like,
 )
 from .pairing import (
     final_exponentiation_check, fq12_prod_tree, is_fq12_one,
     miller_loop,
 )
-from . import tower
 
 NEG_G1_GEN = (pc.G1_GEN[0], -pc.G1_GEN[1])
 

@@ -10,7 +10,6 @@ serialization conformance check.
 from __future__ import annotations
 
 import os
-import tempfile
 
 from ..proto import Checkpoint, active_types
 from .kv import KVStore, slot_key

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import threading
 from bisect import bisect_right
-from dataclasses import dataclass, field
 
 
 class Counter:

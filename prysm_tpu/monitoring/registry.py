@@ -1,0 +1,148 @@
+"""Declared metric registry: the single source of truth for metric
+NAMES.
+
+``metrics.py`` is deliberately create-on-first-use (services mint
+counters lazily so the hot path never pays a registration check) —
+which means a typo'd counter name silently mints a fresh, forever-zero
+metric instead of failing.  Production scrapes then chart the wrong
+series, and bench.py stamps a zero into the tier JSON where the real
+number lives under the misspelled twin.
+
+This module closes that hole DECLARATIVELY: every metric name the tree
+may use is declared here with its kind, and the static-analysis gate
+(``prysm_tpu/analysis``, ``make lint``, tier-1
+``tests/test_analysis.py``) enforces both directions:
+
+* a name used anywhere in ``prysm_tpu/`` or ``bench.py`` that is not
+  declared here fails the lint (typo / unregistered metric);
+* a name declared here that nothing uses fails the lint (dead metric —
+  delete the declaration or the feature that was supposed to emit it).
+
+Dynamic families (``fault_injected_{point}``,
+``megabatch_flushes_{reason}``) expand here from the SAME constants
+the runtime uses (``runtime.faults._POINTS``, ``sched.megabatch``
+flush reasons), so adding an injection point or a flush reason
+auto-extends the declared set — no second bookkeeping site.
+
+To add a new metric: declare it in ``_BASE`` below (kind + one-line
+help), then emit it.  The lint fails until BOTH halves exist.
+"""
+
+from __future__ import annotations
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+# name -> (kind, help).  Keep alphabetical within each block.
+_BASE: dict[str, tuple[str, str]] = {
+    # --- fused slot-verify pipeline / degradation ladder (PR 1-2)
+    "degraded_dispatches": (
+        COUNTER, "batches that fell back to the pure per-entry rung"),
+    "dispatch_resubmits": (
+        COUNTER, "order-preserving ticket re-dispatches after a fault"),
+    "fail_closed_abandons": (
+        COUNTER, "slots resolved False by abandon/close, never verified"),
+    "fused_verify_retries": (
+        COUNTER, "bounded retries of the fused dispatch after a "
+                 "transient fault"),
+    "breaker_open": (GAUGE, "fused-path circuit breaker state (0/1)"),
+    "breaker_probes": (COUNTER, "recovery probes while the breaker is "
+                                "open"),
+    "breaker_resets": (COUNTER, "breaker close transitions (recovery)"),
+    "breaker_trips": (COUNTER, "breaker open transitions"),
+    "fault_injected_total": (
+        COUNTER, "injected faults across all points (chaos runs)"),
+    # --- jit compile guard (PR 1)
+    "jit_backend_compiles": (
+        COUNTER, "XLA backend compiles in this process (recompile "
+                 "guard)"),
+    "jit_backend_compile_seconds": (
+        HISTOGRAM, "per-compile XLA backend compile latency"),
+    # --- registry pubkey table (PR 1-2)
+    "pubkey_table_rows": (GAUGE, "device-resident pubkey table rows"),
+    "pubkey_table_rows_synced": (
+        COUNTER, "table rows (re)decompressed by incremental sync"),
+    # --- streaming megabatch scheduler (PR 3)
+    "megabatch_amortized_slot_seconds": (
+        HISTOGRAM, "per-slot amortized latency of a flushed megabatch"),
+    "megabatch_bisects": (
+        COUNTER, "megabatches settled by the bisection rung"),
+    "megabatch_demotions": (
+        COUNTER, "megabatches routed per-slot while the breaker is "
+                 "open"),
+    "megabatch_dispatches": (
+        COUNTER, "megabatches dispatched as one fused ticket"),
+    "megabatch_occupancy": (
+        HISTOGRAM, "slots aboard each flushed megabatch"),
+    "megabatch_retries": (
+        COUNTER, "whole-megabatch resubmit retries after a transient "
+                 "fault"),
+    "megabatch_slots_dispatched": (
+        COUNTER, "slots carried by flushed megabatches"),
+    # --- on-device bisection (PR 7)
+    "bisection_device_verifies": (
+        COUNTER, "fused subset dispatches performed by bisect_verify"),
+    "bisection_isolations": (
+        COUNTER, "single entries isolated False by bisection"),
+    # --- protocol-chaos scenario generators / soak (PR 7)
+    "registry_churn_events": (
+        COUNTER, "deposit-surge / key-replacement events injected"),
+    "reorgs_applied": (COUNTER, "adversarial reorg cycles applied"),
+    "slashings_injected": (
+        COUNTER, "surround-vote slashings flooded into the pool"),
+    "soak_slots": (COUNTER, "slots processed by the soak harness"),
+    # --- node / services
+    "block_processing_seconds": (
+        HISTOGRAM, "per-block processing latency (blockchain service)"),
+    "current_slot": (GAUGE, "wall-clock slot the node ticker is at"),
+    "slot_batch_failures": (
+        COUNTER, "whole-slot batches whose verdict came back False"),
+    "slot_batch_fallbacks": (
+        COUNTER, "slot batches that consumed per-entry fallback "
+                 "verdicts"),
+    "slot_batch_signatures": (
+        COUNTER, "signatures carried by verified slot batches"),
+    "slot_verify_latency_seconds": (
+        HISTOGRAM, "pool->verdict slot verify latency (metric of "
+                   "record)"),
+}
+
+
+def _expansions() -> dict[str, tuple[str, str]]:
+    """Dynamic families, expanded from the runtime's own constants."""
+    from ..runtime.faults import _POINTS
+    from ..sched.megabatch import (
+        FLUSH_CLOSE, FLUSH_DEMAND, FLUSH_FULL, FLUSH_LINGER,
+        FLUSH_TABLE_SWITCH,
+    )
+
+    out: dict[str, tuple[str, str]] = {}
+    for p in _POINTS:
+        out[f"fault_injected_{p}"] = (
+            COUNTER, f"injected faults at the {p} seam")
+    for r in (FLUSH_FULL, FLUSH_LINGER, FLUSH_DEMAND, FLUSH_CLOSE,
+              FLUSH_TABLE_SWITCH):
+        out[f"megabatch_flushes_{r}"] = (
+            COUNTER, f"megabatch flushes triggered by {r}")
+    return out
+
+
+#: every declared metric: name -> (kind, help)
+METRICS: dict[str, tuple[str, str]] = {**_BASE, **_expansions()}
+
+#: counters bench.py stamps into each tier's JSON when nonzero —
+#: kept HERE so the stamping list and the declared registry cannot
+#: drift apart (a name in this list must be a declared counter).
+BENCH_STAMPED: tuple[str, ...] = (
+    "megabatch_slots_dispatched", "megabatch_dispatches",
+    "megabatch_retries", "megabatch_bisects", "megabatch_demotions",
+    "bisection_device_verifies", "bisection_isolations",
+    "fail_closed_abandons", "reorgs_applied", "slashings_injected",
+    "registry_churn_events", "soak_slots",
+)
+
+for _n in BENCH_STAMPED:
+    assert METRICS.get(_n, (None,))[0] == COUNTER, \
+        f"BENCH_STAMPED name {_n!r} is not a declared counter"
+del _n

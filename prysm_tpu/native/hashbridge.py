@@ -14,7 +14,6 @@ import os
 import subprocess
 import threading
 
-import numpy as np
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))

@@ -24,7 +24,7 @@ from ..core.helpers import (
     compute_signing_root, get_beacon_committee, get_domain,
 )
 from ..crypto.bls import bls
-from ..proto import Attestation, AttestationData
+from ..proto import Attestation
 
 
 class AttestationPoolError(Exception):
@@ -433,13 +433,19 @@ class IndexedSlotBatch:
         returns the un-awaited device value (bool(np.asarray(v))
         blocks).  The pool->verdict pipeline overlaps the next slot's
         host packing with this in-flight dispatch."""
+        from ..analysis.transfer import dispatch_guard
         from ..crypto.bls.xla.verify import fused_slot_verify_device
         from ..runtime import faults as _faults
 
         if len(self) == 0:
             return True
         _faults.fire("device_dispatch")
-        return fused_slot_verify_device(*self.device_args(rng))
+        args = self.device_args(rng)
+        # host-transfer sanitizer (analysis/transfer.py): armed under
+        # PRYSM_TPU_SANITIZE, the fused dispatch itself must not move
+        # bytes between host and device — everything was staged above
+        with dispatch_guard():
+            return fused_slot_verify_device(*args)
 
     def verify(self, rng=None) -> bool:
         """ONE device dispatch: G2 decompression + subgroup checks +

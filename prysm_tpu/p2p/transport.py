@@ -29,7 +29,6 @@ threads are the honest minimal transport for the 2-process demo.
 from __future__ import annotations
 
 import socket
-import struct
 import threading
 
 from . import snappy

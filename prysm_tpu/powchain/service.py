@@ -13,7 +13,7 @@ algorithm.
 from __future__ import annotations
 
 import hashlib
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..config import beacon_config
 from ..core.deposits import DepositTree

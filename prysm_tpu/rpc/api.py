@@ -139,7 +139,6 @@ class ValidatorAPI:
         # whose source matches the proposal state's justified
         # checkpoints (skipped-slot attestations stay eligible)
         from ..core.helpers import (
-            compute_epoch_at_slot as _epoch_at,
             get_current_epoch, get_previous_epoch,
         )
 

@@ -28,7 +28,6 @@ import socketserver
 import struct
 import threading
 
-from ..config import beacon_config
 from ..proto import v1alpha1_pb2 as pb
 from .api import APIError, Duty
 

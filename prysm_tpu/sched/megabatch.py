@@ -30,8 +30,6 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
-import numpy as np
-
 FLUSH_FULL = "full"
 FLUSH_LINGER = "linger"
 FLUSH_DEMAND = "demand"

@@ -23,7 +23,7 @@ from ..operations import AttestationPool
 from ..p2p.bus import (
     Peer, TOPIC_AGGREGATE, TOPIC_ATTESTATION, TOPIC_BLOCK, Verdict,
 )
-from ..proto import Attestation, SignedAggregateAndProof, active_types
+from ..proto import Attestation, SignedAggregateAndProof
 
 RPC_BLOCKS_BY_RANGE = "beacon_blocks_by_range"
 

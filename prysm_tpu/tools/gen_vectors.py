@@ -15,7 +15,6 @@ file (the same code path the tests run)."""
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import sys

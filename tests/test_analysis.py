@@ -1,0 +1,302 @@
+"""Static-analysis gate suite (ISSUE 8).
+
+Two proof obligations per AST checker: it CATCHES the seeded
+violations in its fixture file (``prysm_tpu/analysis/fixtures/`` —
+parsed, never imported, excluded from the tree scan), and it reports
+ZERO findings on the clean tree (the same scan ``make lint`` runs, so
+any future regression fails this ordinary tier-1 run).
+
+The transfer-guard sanitizer is covered in three sizes: the guard
+mechanics on a tiny jitted function (tier-1), the env-gated
+production wiring (tier-1), and the real fused slot-verify dispatch
+under the guard (slow — compiling ``fused_slot_verify_device`` takes
+many minutes on XLA:CPU; tests/test_sched.py documents the same
+economics)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from prysm_tpu.analysis import astlint
+from prysm_tpu.analysis.astlint import (
+    DeadImportChecker, FaultSeamChecker, JitHazardChecker,
+    MetricsRegistryChecker, RecompileHazardChecker, run_checkers,
+    run_tree,
+)
+from prysm_tpu.config import (
+    set_features, use_mainnet_config, use_minimal_config,
+)
+
+FIXTURES = os.path.join(os.path.dirname(astlint.__file__), "fixtures")
+
+
+def _fixture(name):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        return [(f"fixtures/{name}", f.read())]
+
+
+# --- jit-hazard checker ------------------------------------------------------
+
+
+class TestJitHazardFixture:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return run_checkers([JitHazardChecker()],
+                            files=_fixture("jit_hazards.py"))
+
+    def test_seeded_violations_caught(self, findings):
+        msgs = [f.message for f in findings]
+        assert any("`if` on a traced" in m for m in msgs)
+        assert any("`while` on a traced" in m for m in msgs)
+        assert any(m.startswith("bool() on a traced") for m in msgs)
+        assert any("np.asarray() on a traced" in m for m in msgs)
+        assert any("time.time" in m for m in msgs)
+
+    def test_helper_reachable_from_jit_checked(self, findings):
+        # helper_with_clock is not itself jitted; it is flagged
+        # because a jitted function calls it
+        assert any("time.monotonic" in f.message
+                   and "helper_with_clock" in f.message
+                   for f in findings)
+
+    def test_static_shape_branch_not_flagged(self, findings):
+        assert not any("clean_shape_branch" in f.message
+                       for f in findings)
+
+    def test_golden_bls_nondeterminism_flagged(self):
+        src = ("import time\n"
+               "def mix(b):\n"
+               "    return time.time()\n")
+        fs = run_checkers(
+            [JitHazardChecker()],
+            files=[("prysm_tpu/crypto/bls/pure/zz_fake.py", src)])
+        assert len(fs) == 1
+        assert "pure-golden" in fs[0].message
+
+
+# --- recompile-hazard checker ------------------------------------------------
+
+
+class TestRecompileHazardFixture:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return run_checkers([RecompileHazardChecker()],
+                            files=_fixture("recompile_hazards.py"))
+
+    def test_list_literal_to_jitted_flagged(self, findings):
+        assert any("retraces per length" in f.message for f in findings)
+
+    def test_unhashable_static_arg_flagged(self, findings):
+        assert any("static arg 1" in f.message for f in findings)
+
+    def test_restricted_entry_bypass_flagged(self, findings):
+        assert any("bypasses the bucket-padded" in f.message
+                   for f in findings)
+
+
+# --- metrics-registry checker ------------------------------------------------
+
+_FAKE_REGISTRY = {
+    "fail_closed_abandons": ("counter", "test"),
+    "dispatch_resubmits": ("counter", "test"),
+}
+
+
+class TestMetricsRegistryFixture:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return run_checkers(
+            [MetricsRegistryChecker(declared=dict(_FAKE_REGISTRY),
+                                    stamped=())],
+            files=_fixture("bad_metrics.py"))
+
+    def test_typo_counter_flagged(self, findings):
+        assert any("fail_closed_abandonments" in f.message
+                   and "not declared" in f.message for f in findings)
+
+    def test_kind_mismatch_flagged(self, findings):
+        assert any("used as gauge but declared counter" in f.message
+                   for f in findings)
+
+    def test_undeclared_dynamic_family_flagged(self, findings):
+        assert any("nonexistent_family_" in f.message
+                   for f in findings)
+
+    def test_correct_use_not_flagged(self, findings):
+        # both declared names are used in the fixture, so no
+        # dead-metric finding and no finding on the clean inc()
+        assert not any("never used" in f.message for f in findings)
+        assert len(findings) == 3
+
+    def test_dead_declaration_flagged(self):
+        declared = dict(_FAKE_REGISTRY)
+        declared["never_emitted_metric"] = ("counter", "test")
+        fs = run_checkers(
+            [MetricsRegistryChecker(declared=declared, stamped=())],
+            files=_fixture("bad_metrics.py"))
+        assert any("never_emitted_metric" in f.message
+                   and "never used" in f.message for f in fs)
+
+
+# --- fault-seam checker ------------------------------------------------------
+
+
+class TestFaultSeamFixture:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return run_checkers(
+            [FaultSeamChecker(registered=("readback",
+                                          "never_fired_seam"))],
+            files=_fixture("bad_seams.py"))
+
+    def test_unregistered_fire_flagged(self, findings):
+        assert any("totally_unregistered_seam" in f.message
+                   for f in findings)
+
+    def test_dead_seam_flagged(self, findings):
+        assert any("never_fired_seam" in f.message
+                   and "dead seam" in f.message for f in findings)
+
+    def test_registered_and_fired_clean(self, findings):
+        assert not any("'readback'" in f.message for f in findings)
+        assert len(findings) == 2
+
+
+# --- dead-import checker -----------------------------------------------------
+
+
+class TestDeadImportFixture:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return run_checkers([DeadImportChecker()],
+                            files=_fixture("dead_imports.py"))
+
+    def test_unused_imports_flagged(self, findings):
+        msgs = [f.message for f in findings]
+        assert "import 'struct' is never used" in msgs
+        assert "import 'OrderedDict' is never used" in msgs
+
+    def test_unreferenced_private_def_flagged(self, findings):
+        assert any("_dead_helper" in f.message for f in findings)
+
+    def test_used_symbols_clean(self, findings):
+        assert not any("defaultdict" in f.message
+                       or "_used_helper" in f.message
+                       or "'os'" in f.message for f in findings)
+        assert len(findings) == 3
+
+
+# --- the gate itself ---------------------------------------------------------
+
+
+class TestCleanTree:
+    def test_full_gate_zero_findings(self):
+        """The tier-1 anchor: the exact scan `make lint` runs must be
+        clean — 0 false positives on the real tree, and any future
+        true positive fails the ordinary test run."""
+        findings = run_tree()
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_scan_covers_bench_and_skips_fixtures(self):
+        paths = [p for p, _src in astlint.iter_tree_files()]
+        assert "bench.py" in paths
+        assert any(p.startswith("prysm_tpu/analysis/") for p in paths)
+        assert not any("fixtures" in p for p in paths)
+
+    def test_registry_families_expand_from_runtime_constants(self):
+        from prysm_tpu.monitoring.registry import (
+            BENCH_STAMPED, COUNTER, METRICS,
+        )
+        from prysm_tpu.runtime.faults import _POINTS
+
+        for p in _POINTS:
+            assert METRICS[f"fault_injected_{p}"][0] == COUNTER
+        assert set(BENCH_STAMPED) <= set(METRICS)
+
+
+# --- transfer-guard sanitizer ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def minimal_xla():
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    yield
+    set_features(bls_implementation="pure")
+    use_mainnet_config()
+
+
+@pytest.fixture(scope="module")
+def genesis(minimal_xla):
+    from prysm_tpu.config import MINIMAL_CONFIG
+    from prysm_tpu.proto import build_types
+    from prysm_tpu.testing import util as testutil
+
+    return testutil.deterministic_genesis_state(
+        16, build_types(MINIMAL_CONFIG))
+
+
+class TestTransferGuard:
+    def test_guard_blocks_implicit_h2d(self):
+        import jax
+        import jax.numpy as jnp
+
+        from prysm_tpu.analysis.transfer import host_sync_guard
+
+        f = jax.jit(lambda x: x * 2)
+        staged = jnp.arange(8, dtype=jnp.float32)
+        f(staged).block_until_ready()         # compile OUTSIDE guard
+        with host_sync_guard():               # staged args: clean
+            f(staged).block_until_ready()
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            with host_sync_guard():           # raw np arg: implicit h2d
+                f(np.arange(8, dtype=np.float32)).block_until_ready()
+
+    def test_dispatch_guard_is_env_gated(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from prysm_tpu.analysis import transfer
+
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.arange(4, dtype=jnp.float32)).block_until_ready()
+        raw = np.arange(4, dtype=np.float32)
+        monkeypatch.delenv(transfer.SANITIZE_ENV, raising=False)
+        assert not transfer.sanitize_enabled()
+        with transfer.dispatch_guard():       # disarmed: no-op
+            f(raw).block_until_ready()
+        monkeypatch.setenv(transfer.SANITIZE_ENV, "1")
+        assert transfer.sanitize_enabled()
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            with transfer.dispatch_guard():
+                f(raw).block_until_ready()
+
+    @pytest.mark.slow
+    def test_fused_slot_verify_dispatch_is_transfer_free(
+            self, genesis, monkeypatch):
+        """The acceptance anchor: the REAL fused slot-verify dispatch
+        runs under the transfer guard — every argument is staged by
+        ``device_args`` and the jitted call moves no bytes."""
+        from prysm_tpu.analysis import transfer
+        from prysm_tpu.crypto.bls.xla.verify import (
+            fused_slot_verify_device,
+        )
+        from prysm_tpu.operations.attestations import AttestationPool
+        from prysm_tpu.testing import util as testutil
+
+        pool = AttestationPool()
+        pool.save_aggregated(testutil.valid_attestation(genesis, 1, 0))
+        batch = pool.build_slot_batch_indexed(genesis, 1)
+        assert len(batch) == 1
+        monkeypatch.delenv(transfer.SANITIZE_ENV, raising=False)
+        # warm-up OUTSIDE the guard: compilation transfers constants
+        assert bool(np.asarray(batch.verify_async()))
+        args = batch.device_args()
+        with transfer.host_sync_guard():
+            v = fused_slot_verify_device(*args)
+        assert bool(np.asarray(v))
+        # and through the production wiring: verify_async itself wraps
+        # the dispatch in dispatch_guard() when the env var is set
+        monkeypatch.setenv(transfer.SANITIZE_ENV, "1")
+        assert bool(np.asarray(batch.verify_async()))
