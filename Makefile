@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow lint chaos stream soak warm-cache dryrun bench native proto
+.PHONY: test test-slow lint chaos stream soak warm-cache dryrun bench native proto race
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -82,6 +82,13 @@ dryrun:
 
 bench:
 	$(PY) bench.py
+
+# Re-race the pallas tier against the XLA tier on the real chip
+# (writes PALLAS_RACE.json).  Budgeted: the SIGALRM guard flushes
+# partial results if one pathological Mosaic compile eats the wall
+# clock.  Run TPU-attached.
+race:
+	PRYSM_RACE_BUDGET=900 $(PY) -m prysm_tpu.tools.pallas_race
 
 # Regenerate the protobuf module from the v1alpha1 service schema.
 proto:

@@ -612,9 +612,16 @@ def build_synthetic_slot_batch(n_committees: int, committee_size: int,
             # COMMIT the big operands to a concrete device: an
             # uncommitted array can be re-staged through the transport
             # per dispatch under sharding-mismatch fallbacks, charging
-            # the ~MB pk batch to every timed iteration
-            dev = jax.devices()[0]
-            put = lambda a: jax.device_put(jnp.asarray(a), dev)  # noqa: E731
+            # the ~MB pk batch to every timed iteration.  Single-
+            # device only (the TPU bench this was added for): an array
+            # committed to device 0 poisons any multi-device jit that
+            # consumes it — the 8-virtual-device test mesh's sharded
+            # verify rejects it with "incompatible devices".
+            if len(jax.devices()) == 1:
+                dev = jax.devices()[0]
+                put = lambda a: jax.device_put(jnp.asarray(a), dev)  # noqa: E731
+            else:
+                put = jnp.asarray
             return {
                 "pk_jac": tuple(put(z[f"pk{i}"]) for i in range(3)),
                 "sig_jac": tuple(put(z[f"sig{i}"]) for i in range(3)),

@@ -340,3 +340,52 @@ def mul(a: LZ, b: LZ) -> LZ:
 
 def sqr(a: LZ) -> LZ:
     return mul(a, a)
+
+
+def mul_wide(pairs):
+    """All the independent products of ONE formula stage as a SINGLE
+    Montgomery core call.
+
+    ``pairs`` is a list of ``(LZ, LZ)`` operand pairs of arbitrary,
+    mutually different shapes (each pair's operands must broadcast to
+    a common ``(..., NLIMBS)`` shape).  Every operand is normalized,
+    flattened to ``(rows, NLIMBS)``, the rows of all pairs are
+    concatenated, and ONE batched Montgomery multiply produces every
+    product — the wide-batch regime where the Mosaic kernel amortizes
+    its launch and the XLA core its column setup.  Returns the product
+    LZ values in input order, reshaped back.
+
+    This is the primitive behind the wide-step Miller ladder: the
+    doubling rung's fq12 squaring, point formulas and line evaluation
+    each contribute pairs to a shared call instead of issuing 7
+    narrow sequential multiplies.
+    """
+    norm = []
+    for a, b in pairs:
+        a = norm_operand(a)
+        b = norm_operand(b)
+        shp = jnp.broadcast_shapes(a.arr.shape, b.arr.shape)
+        norm.append((jnp.broadcast_to(a.arr, shp),
+                     jnp.broadcast_to(b.arr, shp), shp))
+    if len(norm) == 1:
+        fa, fb, shp = norm[0]
+        rows, shapes = None, [shp]
+    else:
+        rows = [int(np.prod(s[:-1], dtype=np.int64)) for *_, s in norm]
+        shapes = [s for *_, s in norm]
+        fa = jnp.concatenate([x.reshape(-1, L.NLIMBS) for x, _, _ in norm])
+        fb = jnp.concatenate([y.reshape(-1, L.NLIMBS) for _, y, _ in norm])
+    if L.use_mosaic_mul():
+        from .pallas_mont import mont_mul_pallas
+
+        out, hi = mont_mul_pallas(fa, fb), 1.0
+    else:
+        out = L._mont_reduce(L._mul_columns(fa, fb), csub=False)
+        hi = P_OVER_R * 4.0 + 1.0       # operands < 2P each
+    if rows is None:
+        return [LZ(out, hi, B - 1)]
+    res, off = [], 0
+    for s, r in zip(shapes, rows):
+        res.append(LZ(out[off:off + r].reshape(s), hi, B - 1))
+        off += r
+    return res

@@ -34,6 +34,7 @@ come out < 2P and are conditionally reduced once.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import numpy as np
@@ -335,12 +336,26 @@ def set_mul_backend(name: str) -> None:
     if name not in ("xla", "pallas"):
         raise ValueError(f"unknown mul backend {name!r}")
     if name != _MUL_BACKEND:
+        from ....monitoring.metrics import metrics
+
         _MUL_BACKEND = name
+        metrics.inc("tower_backend_selections")
         jax.clear_caches()
 
 
 def get_mul_backend() -> str:
     return _MUL_BACKEND
+
+
+# Opt-in env gate for the Pallas tower backend: flips the Montgomery
+# routing BEFORE any graph is traced (import time), so the whole
+# Miller ladder / final-exp pow scans trace against the kernels.  On
+# CPU the kernels run under interpret=True (how tier-1 proves
+# bit-exactness without a TPU); on TPU the kernel is already the only
+# correct path (see use_mosaic_mul).
+_ENV_TOWER_BACKEND = os.environ.get("PRYSM_TPU_TOWER_BACKEND", "")
+if _ENV_TOWER_BACKEND:
+    set_mul_backend(_ENV_TOWER_BACKEND)
 
 
 def use_mosaic_mul() -> bool:

@@ -48,7 +48,6 @@ from ..params import BLS_X_ABS, P, R
 from . import lazy as Zl
 from . import limbs as L
 from . import tower as T
-from .curve import _mul_many
 
 # bits of |x| after the leading 1, MSB-first (static Python constants)
 X_BITS = [int(b) for b in bin(BLS_X_ABS)[3:]]
@@ -58,92 +57,133 @@ X_BITS = [int(b) for b in bin(BLS_X_ABS)[3:]]
 D_HARD = (P ** 4 - P ** 2 + 1) // R
 
 
-def _line_to_fq12(s00, s11, s12):
-    """Assemble a sparse line into a full Fq12 array (slots (0,0),
-    (1,1), (1,2) in the w/v/u nesting)."""
-    zero = jnp.zeros_like(s00)
-    c0 = jnp.stack([s00, zero, zero], axis=-3)
-    c1 = jnp.stack([zero, s11, s12], axis=-3)
-    return jnp.stack([c0, c1], axis=-4)
-
-
 def _fp_pair(s: "Zl.LZ") -> "Zl.LZ":
     """Fp scalar -> (s, s) along the Fq2 coefficient axis."""
     return Zl.stack([s, s], axis=-2)
 
 
-def _mul_many_fq2(pairs):
-    """Independent Fq2 multiplies of one step stage as ONE stacked
-    Karatsuba/Montgomery call (shared impl: curve._mul_many)."""
-    return _mul_many(T._fq2_mul_lz, 2, pairs)
+def _line_lz(s00, s11, s12) -> "Zl.LZ":
+    """Assemble a sparse LAZY line into a full Fq12 LZ (slots (0,0),
+    (1,1), (1,2) in the w/v/u nesting).  One stacked canon2p pulls the
+    slots back inside the lazy bound budget before the Fq12 multiply's
+    Karatsuba pre-adds (the narrow path canonicalized them at the same
+    boundary); the zero slots carry exact zero limbs."""
+    s = Zl.canon2p(Zl.stack([s00, s11, s12], axis=0))
+    s00, s11, s12 = (Zl.index(s, i) for i in range(3))
+    zero = Zl.LZ(jnp.zeros_like(s00.arr), 1.0, 0)
+    c0 = Zl.stack([s00, zero, zero], axis=-3)
+    c1 = Zl.stack([zero, s11, s12], axis=-3)
+    return Zl.stack([c0, c1], axis=-4)
 
 
-def _dbl_step(t, xp, yp):
-    """Double T and evaluate the tangent line at P=(xp, yp) (Fp).
+def _fq2_pre_many(pairs):
+    """Stack same-shape independent Fq2 multiplies along a fresh -3
+    axis and Karatsuba-pre them into ONE Fp-level multiplicand pair —
+    a single entry for lazy.mul_wide."""
+    la = Zl.stack([a for a, _ in pairs], axis=-3)
+    lb = Zl.stack([b for _, b in pairs], axis=-3)
+    return T._fq2_mul_pre(la, lb)
 
-    Returns (T2, line_fq12).  Runs on the redundant-form (lazy.py)
-    domain — this body IS the Miller scan, the deepest compile-critical
-    graph in slot verification — with ONE stacked canonicalization for
-    the three output coords and three line slots."""
+
+def _fq2_post_many(t, n: int):
+    """Inverse of _fq2_pre_many: combine the wide product back into n
+    Fq2 values."""
+    out = T._fq2_mul_post(t)
+    return tuple(Zl.index(out, (Ellipsis, i, slice(None), slice(None)))
+                 for i in range(n))
+
+
+def _dbl_step_wide(f, t, xp, yp):
+    """One WIDE Miller doubling rung: the Fq12 squaring of f, the
+    Jacobian doubling of T, the tangent-line evaluation at P=(xp, yp)
+    and the f^2 * line multiply — restructured so every stage's
+    independent multiplies ride ONE lazy.mul_wide Montgomery call.
+
+    Four sequential core calls replace the narrow path's seven
+    (fq12_sqr + three point-formula stages + line scaling + fq12_mul),
+    and the first stage alone is 48 Fp products per pair — the wide
+    batch regime where the Pallas Montgomery kernel amortizes its
+    launch (PALLAS_RACE.json: 5.44 us vs 23.63 us/op at b8192).
+    Bit-exact vs the narrow schedule: same formulas, and the boundary
+    canonicalizations produce the unique representatives either way."""
     X, Y, Z = (Zl.wrap(c) for c in t)
     xpw, ypw = Zl.wrap(xp), Zl.wrap(yp)
-    mm = _mul_many_fq2
-    A, B, ZZ = mm([(X, X), (Y, Y), (Z, Z)])
-    XB = Zl.add(X, B)
-    C, t2 = mm([(B, B), (XB, XB)])          # Y^4, (X+Y^2)^2
+    # stage 1: f's squaring rides with the first doubling products
+    r1 = Zl.mul_wide([T._fq12_sqr_pre(Zl.wrap(f)),
+                      _fq2_pre_many([(X, X), (Y, Y), (Z, Z), (Y, Z)])])
+    # one renormalization here keeps the Karatsuba pre-adds of stage 4
+    # inside the lazy bound budget (the narrow path canonicalized after
+    # its fq12_sqr at the same place)
+    f2 = Zl.canon2p(T._fq12_sqr_post(r1[0]))
+    A, B, ZZ, YZ = _fq2_post_many(r1[1], 4)
+    # stage 2
     E = Zl.mul_small(A, 3)                  # 3X^2
-    D = Zl.mul_small(Zl.sub(Zl.sub(t2, A), C), 2)
-    F, YZ = mm([(E, E), (Y, Z)])
-    X3 = Zl.canon2p(Zl.sub(F, Zl.mul_small(D, 2)))  # reused: D-X3
+    XB = Zl.add(X, B)
     Z3 = Zl.mul_small(YZ, 2)
-    Y3m, c_y, c_x, EX = mm(
-        [(E, Zl.sub(D, X3)), (Z3, ZZ), (E, ZZ), (E, X)])
+    r2 = Zl.mul_wide([_fq2_pre_many(
+        [(B, B), (XB, XB), (E, E), (Z3, ZZ), (E, ZZ), (E, X)])])
+    C, t2, F, c_y, c_x, EX = _fq2_post_many(r2[0], 6)
+    # stage 3: Y3's product + the line-coefficient scaling by (yp, xp)
+    D = Zl.mul_small(Zl.sub(Zl.sub(t2, A), C), 2)
+    X3 = Zl.canon2p(Zl.sub(F, Zl.mul_small(D, 2)))  # reused: D-X3
+    r3 = Zl.mul_wide(
+        [_fq2_pre_many([(E, Zl.sub(D, X3))]),
+         (Zl.stack([c_y, c_x], axis=-3),
+          Zl.stack([_fp_pair(ypw), _fp_pair(xpw)], axis=-3))])
+    (Y3m,) = _fq2_post_many(r3[0], 1)
+    lp = r3[1]
     Y3 = Zl.sub(Y3m, Zl.mul_small(C, 8))
     c_0 = Zl.sub(Zl.mul_small(B, 2), EX)
-
-    # line coefficients (see module docstring)
-    lp = Zl.mul(Zl.stack([c_y, c_x], axis=-3),
-                Zl.stack([_fp_pair(ypw), _fp_pair(xpw)], axis=-3))
+    # line slots (see module docstring) stay lazy into the multiply
     s00 = T._fq2_xi_lz(Zl.index(lp, (Ellipsis, 0, slice(None),
                                      slice(None))))
     s12 = Zl.neg(Zl.index(lp, (Ellipsis, 1, slice(None),
                                slice(None))))
     s11 = Zl.neg(c_0)
-    arr = Zl.canon(Zl.stack([X3, Y3, Z3, s00, s11, s12], axis=0))
-    return ((arr[0], arr[1], arr[2]),
-            _line_to_fq12(arr[3], arr[4], arr[5]))
+    # stage 4: f^2 * line (all 54 Fp products in one call)
+    fz = T._fq12_mul_lz(f2, _line_lz(s00, s11, s12))
+    arr = Zl.canon(Zl.stack([X3, Y3, Z3], axis=0))
+    return Zl.canon(fz), (arr[0], arr[1], arr[2])
 
 
-def _add_step(t, q_aff, xp, yp):
-    """Mixed-add affine Q into Jacobian T; line through T and Q at P.
-    Lazy-domain body, one stacked boundary canonicalization."""
+def _add_step_wide(f, t, q_aff, xp, yp):
+    """One WIDE Miller add rung: mixed-add affine Q into T, the line
+    through T and Q at P, and the f * line multiply — five mul_wide
+    calls replace the narrow path's eight, with the f * line Fq12
+    multiply fused into the last point-formula stage."""
     x2, y2 = (Zl.wrap(c) for c in q_aff)
     X, Y, Z = (Zl.wrap(c) for c in t)
     xpw, ypw = Zl.wrap(xp), Zl.wrap(yp)
-    fm, mm = T._fq2_mul_lz, _mul_many_fq2
-    ZZ = T._fq2_sqr_lz(Z)
-    U2, SZ = mm([(x2, ZZ), (y2, Z)])
-    S2 = fm(SZ, ZZ)
+    r1 = Zl.mul_wide([_fq2_pre_many([(Z, Z), (y2, Z)])])
+    ZZ, SZ = _fq2_post_many(r1[0], 2)
+    r2 = Zl.mul_wide([_fq2_pre_many([(x2, ZZ), (SZ, ZZ)])])
+    U2, S2 = _fq2_post_many(r2[0], 2)
     H = Zl.sub(U2, X)
     Rr = Zl.sub(S2, Y)
-    HH, R2 = mm([(H, H), (Rr, Rr)])
-    HHH, V, Z3 = mm([(H, HH), (X, HH), (Z, H)])
+    r3 = Zl.mul_wide([_fq2_pre_many([(H, H), (Rr, Rr), (Z, H)])])
+    HH, R2, Z3 = _fq2_post_many(r3[0], 3)
+    r4 = Zl.mul_wide(
+        [_fq2_pre_many([(H, HH), (X, HH), (Z3, y2), (Rr, x2)]),
+         (Zl.stack([Z3, Rr], axis=-3),
+          Zl.stack([_fp_pair(ypw), _fp_pair(xpw)], axis=-3))])
+    HHH, V, Zy2, Rx2 = _fq2_post_many(r4[0], 4)
+    lp = r4[1]
     X3 = Zl.canon2p(Zl.sub(Zl.sub(R2, HHH), Zl.mul_small(V, 2)))
-    RVX, YH, Zy2, Rx2 = mm(
-        [(Rr, Zl.sub(V, X3)), (Y, HHH), (Z3, y2), (Rr, x2)])
-    Y3 = Zl.sub(RVX, YH)
     c_0 = Zl.sub(Zy2, Rx2)
-
-    lp = Zl.mul(Zl.stack([Z3, Rr], axis=-3),
-                Zl.stack([_fp_pair(ypw), _fp_pair(xpw)], axis=-3))
     s00 = T._fq2_xi_lz(Zl.index(lp, (Ellipsis, 0, slice(None),
                                      slice(None))))
     s12 = Zl.neg(Zl.index(lp, (Ellipsis, 1, slice(None),
                                slice(None))))
     s11 = Zl.neg(c_0)
-    arr = Zl.canon(Zl.stack([X3, Y3, Z3, s00, s11, s12], axis=0))
-    return ((arr[0], arr[1], arr[2]),
-            _line_to_fq12(arr[3], arr[4], arr[5]))
+    # stage 5: Y3's two products fused with the f * line multiply
+    r5 = Zl.mul_wide(
+        [_fq2_pre_many([(Rr, Zl.sub(V, X3)), (Y, HHH)]),
+         T._fq12_mul_pre(Zl.wrap(f), _line_lz(s00, s11, s12))])
+    RVX, YH = _fq2_post_many(r5[0], 2)
+    fz = T._fq12_mul_post(r5[1])
+    Y3 = Zl.sub(RVX, YH)
+    arr = Zl.canon(Zl.stack([X3, Y3, Z3], axis=0))
+    return Zl.canon(fz), (arr[0], arr[1], arr[2])
 
 
 @jax.jit
@@ -165,14 +205,10 @@ def miller_loop(p_aff, q_aff):
 
     def body(carry, bit):
         f, t = carry
-        f = T.fq12_sqr(f)
-        t, line = _dbl_step(t, xp, yp)
-        f = T.fq12_mul(f, line)
+        f, t = _dbl_step_wide(f, t, xp, yp)
 
         def with_add(args):
-            f, t = args
-            t2, line2 = _add_step(t, (x2, y2), xp, yp)
-            return T.fq12_mul(f, line2), t2
+            return _add_step_wide(*args, (x2, y2), xp, yp)
 
         f, t = lax.cond(bit == 1, with_add, lambda a: a, (f, t))
         return (f, t), None

@@ -73,6 +73,10 @@ def _mont_mul_flat(a_t, b_t, interpret: bool):
 def mont_mul_pallas(a, b, interpret: bool | None = None):
     """Drop-in for limbs.fp_mul: Montgomery product of uint32[..., 24]
     operands (any broadcastable leading batch dims)."""
+    from ....monitoring.metrics import metrics
+
+    # trace-time count of kernel call sites reaching device graphs
+    metrics.inc("pallas_tower_dispatches")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     shape = jnp.broadcast_shapes(a.shape, b.shape)

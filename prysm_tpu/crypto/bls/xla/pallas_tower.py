@@ -181,6 +181,9 @@ def _fq12_mul_flat(a_t, b_t, interpret: bool):
 
 def fq12_mul_pallas(a, b, interpret: bool | None = None):
     """Drop-in for tower.fq12_mul: (..., 2, 3, 2, 24) uint32 operands."""
+    from ....monitoring.metrics import metrics
+
+    metrics.inc("pallas_tower_dispatches")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     shape = jnp.broadcast_shapes(a.shape, b.shape)

@@ -1,0 +1,86 @@
+"""Structural probes over traced pairing graphs (jaxpr inspection).
+
+The multi-pairing restructure guarantees the fused RLC verify runs
+ONE shared Miller doubling ladder over all concatenated pairs and ONE
+final exponentiation for the whole slot.  That property is invisible
+to value-level tests (a second serialized ladder computes the same
+verdict, just ~2x slower), so the regression tests prove it from the
+traced jaxpr itself: count ``lax.scan`` equations by their static
+``(length, num_carry)`` signature, recursing through nested jaxprs
+(pjit bodies, cond branches, the scans themselves).
+
+Signatures in a pairing-check graph (all static at trace time):
+
+* Miller ladder: length 63 (``pairing.X_BITS`` — the post-leading
+  bits of |x|), num_carry 4 (f plus the Jacobian X/Y/Z of T).
+* pow-by-|x|: length 63, num_carry 1 (the accumulator).  Each
+  ``final_exponentiation_check`` is exactly FIVE of these in series
+  (the (x-1)^2 (x+p) (x^2+p^2-1) + 3 decomposition), so "one final
+  exponentiation" == five pow scans.
+
+Every other scan in the graph has a different length (Fermat
+inversion digits, GLV scalar-mul windows, product-tree chunks), so
+the signatures identify the ladders uniquely.
+
+Tracing is abstract evaluation only — no compile, no execution — so
+the probes are tier-1 safe even on full fused slot graphs.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import jax
+from jax.extend import core as jex_core
+
+from .pairing import X_BITS
+
+MILLER_SCAN_LEN = len(X_BITS)          # 63
+MILLER_NUM_CARRY = 4                   # f + Jacobian (X, Y, Z)
+POWX_NUM_CARRY = 1                     # the pow accumulator
+POWX_PER_FINAL_EXP = 5                 # see final_exponentiation_check
+
+
+def _subjaxprs(params):
+    """Yield every jaxpr nested in an eqn's params (scan/cond/pjit/
+    while bodies), whatever key or container they hide in."""
+    for value in params.values():
+        stack = [value]
+        while stack:
+            v = stack.pop()
+            if isinstance(v, jex_core.ClosedJaxpr):
+                yield v.jaxpr
+            elif isinstance(v, jex_core.Jaxpr):
+                yield v
+            elif isinstance(v, (tuple, list)):
+                stack.extend(v)
+
+
+def _walk(jaxpr, counts: Counter) -> None:
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            counts[(int(eqn.params["length"]),
+                    int(eqn.params["num_carry"]))] += 1
+        for sub in _subjaxprs(eqn.params):
+            _walk(sub, counts)
+
+
+def scan_signature_counts(fn, *args, **kwargs) -> Counter:
+    """Abstractly trace ``fn(*args, **kwargs)`` and count every
+    lax.scan equation by (length, num_carry)."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    counts: Counter = Counter()
+    _walk(closed.jaxpr, counts)
+    return counts
+
+
+def miller_final_exp_counts(fn, *args, **kwargs) -> tuple[int, int]:
+    """(number of Miller ladders, number of final exponentiations) in
+    the traced graph of ``fn`` — the pair the one-ladder regression
+    tests assert equals (1, 1)."""
+    counts = scan_signature_counts(fn, *args, **kwargs)
+    millers = counts[(MILLER_SCAN_LEN, MILLER_NUM_CARRY)]
+    powx = counts[(MILLER_SCAN_LEN, POWX_NUM_CARRY)]
+    assert powx % POWX_PER_FINAL_EXP == 0, \
+        f"stray pow-by-x scans: {powx}"
+    return millers, powx // POWX_PER_FINAL_EXP

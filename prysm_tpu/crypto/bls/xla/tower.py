@@ -137,18 +137,39 @@ def _lz_fq2(c0: Z.LZ, c1: Z.LZ) -> Z.LZ:
     return Z.stack([c0, c1], axis=-2)
 
 
-def _fq2_mul_lz(a: Z.LZ, b: Z.LZ) -> Z.LZ:
-    """Karatsuba: ONE batched Montgomery mul of 3 stacked operands."""
+# Every Karatsuba level splits into a ``pre`` half (stack the
+# operands — pure adds) and a ``post`` half (combine the stacked
+# products — pure adds/subs/canon), with the single multiply BETWEEN
+# them owned by the caller.  The narrow entry points below compose
+# pre -> Z.mul -> post; the wide-step Miller ladder (pairing.py)
+# instead feeds several stages' pre outputs into ONE lazy.mul_wide
+# call, so e.g. the doubling rung's fq12 squaring, point formulas and
+# line evaluation share a single Montgomery-batched dispatch.
+
+
+def _fq2_mul_pre(a: Z.LZ, b: Z.LZ):
+    """Karatsuba operand stacking: (a, b) -> the two stacked Fp-level
+    multiplicand arrays of the 3-mul schedule."""
     a0, a1 = _lz_c(a, 0), _lz_c(a, 1)
     b0, b1 = _lz_c(b, 0), _lz_c(b, 1)
     la = Z.stack([a0, a1, Z.add(a0, a1)], axis=-2)
     lb = Z.stack([b0, b1, Z.add(b0, b1)], axis=-2)
-    t = Z.mul(la, lb)
+    return la, lb
+
+
+def _fq2_mul_post(t: Z.LZ) -> Z.LZ:
+    """Combine the 3 stacked Fp products back into an Fq2 value."""
     t0, t1, t2 = (Z.index(t, (Ellipsis, i, slice(None)))
                   for i in range(3))
     c0 = Z.sub(t0, t1)
     c1 = Z.sub(Z.sub(t2, t0), t1)
     return _lz_fq2(c0, c1)
+
+
+def _fq2_mul_lz(a: Z.LZ, b: Z.LZ) -> Z.LZ:
+    """Karatsuba: ONE batched Montgomery mul of 3 stacked operands."""
+    la, lb = _fq2_mul_pre(a, b)
+    return _fq2_mul_post(Z.mul(la, lb))
 
 
 def _fq2_sqr_lz(a: Z.LZ) -> Z.LZ:
@@ -238,24 +259,36 @@ def _lz_d(a: Z.LZ, i: int) -> Z.LZ:
     return Z.index(a, (Ellipsis, i, slice(None), slice(None)))
 
 
-def _fq6_mul_lz(a: Z.LZ, b: Z.LZ) -> Z.LZ:
-    """Toom/Karatsuba 6-mul schedule: ONE stacked _fq2_mul_lz call
-    (so ONE batched Montgomery multiply for all 18 Fp products)."""
+def _fq6_mul_pre(a: Z.LZ, b: Z.LZ):
+    """6-mul Toom/Karatsuba operand stacking, flattened down to the
+    Fp-level multiplicand pair (composes _fq2_mul_pre)."""
     a0, a1, a2 = (_lz_d(a, i) for i in range(3))
     b0, b1, b2 = (_lz_d(b, i) for i in range(3))
     la = Z.stack([a0, a1, a2, Z.add(a1, a2), Z.add(a0, a1),
                   Z.add(a0, a2)], axis=-3)
     lb = Z.stack([b0, b1, b2, Z.add(b1, b2), Z.add(b0, b1),
                   Z.add(b0, b2)], axis=-3)
-    # one canon2p per level keeps the sub-spread constants (k*P per
-    # lazy subtraction) from compounding through the nesting — without
-    # it the tracked bounds grow ~5x per level
-    t = Z.canon2p(_fq2_mul_lz(la, lb))
+    return _fq2_mul_pre(la, lb)
+
+
+def _fq6_mul_post(tp: Z.LZ) -> Z.LZ:
+    """Fp-level products -> Fq6 value.  The one canon2p per level
+    keeps the sub-spread constants (k*P per lazy subtraction) from
+    compounding through the nesting — without it the tracked bounds
+    grow ~5x per level."""
+    t = Z.canon2p(_fq2_mul_post(tp))
     t0, t1, t2, t12, t01, t02 = (_lz_d(t, i) for i in range(6))
     c0 = Z.add(t0, _fq2_xi_lz(Z.sub(Z.sub(t12, t1), t2)))
     c1 = Z.add(Z.sub(Z.sub(t01, t0), t1), _fq2_xi_lz(t2))
     c2 = Z.add(Z.sub(Z.sub(t02, t0), t2), t1)
     return Z.stack([c0, c1, c2], axis=-3)
+
+
+def _fq6_mul_lz(a: Z.LZ, b: Z.LZ) -> Z.LZ:
+    """Toom/Karatsuba 6-mul schedule: ONE stacked Montgomery multiply
+    for all 18 Fp products."""
+    la, lb = _fq6_mul_pre(a, b)
+    return _fq6_mul_post(Z.mul(la, lb))
 
 
 def _fq6_v_lz(a: Z.LZ) -> Z.LZ:
@@ -347,18 +380,31 @@ def _lz_w(a: Z.LZ, i: int) -> Z.LZ:
                        slice(None)))
 
 
-def _fq12_mul_lz(a: Z.LZ, b: Z.LZ) -> Z.LZ:
-    """Karatsuba over Fq6: ONE batched Montgomery multiply for all 54
-    Fp products of a full Fq12 multiply."""
+def _fq12_mul_pre(a: Z.LZ, b: Z.LZ):
+    """Karatsuba-over-Fq6 operand stacking, flattened down to the
+    Fp-level multiplicand pair (all 54 Fp products of a full Fq12
+    multiply in one batch)."""
     a0, a1 = _lz_w(a, 0), _lz_w(a, 1)
     b0, b1 = _lz_w(b, 0), _lz_w(b, 1)
     la = Z.stack([a0, a1, Z.add(a0, a1)], axis=-4)
     lb = Z.stack([b0, b1, Z.add(b0, b1)], axis=-4)
-    t = Z.canon2p(_fq6_mul_lz(la, lb))     # see _fq6_mul_lz on spreads
+    return _fq6_mul_pre(la, lb)
+
+
+def _fq12_mul_post(tp: Z.LZ) -> Z.LZ:
+    """Fp-level products -> Fq12 value."""
+    t = Z.canon2p(_fq6_mul_post(tp))     # see _fq6_mul_post on spreads
     t0, t1, t2 = (_lz_w(t, i) for i in range(3))
     c0 = Z.add(t0, _fq6_v_lz(t1))
     c1 = Z.sub(Z.sub(t2, t0), t1)
     return Z.stack([c0, c1], axis=-4)
+
+
+def _fq12_mul_lz(a: Z.LZ, b: Z.LZ) -> Z.LZ:
+    """Karatsuba over Fq6: ONE batched Montgomery multiply for all 54
+    Fp products of a full Fq12 multiply."""
+    la, lb = _fq12_mul_pre(a, b)
+    return _fq12_mul_post(Z.mul(la, lb))
 
 
 @jax.jit
@@ -369,16 +415,27 @@ def fq12_sqr(a):
         from .pallas_tower import fq12_sqr_pallas
 
         return fq12_sqr_pallas(a)
-    la_ = Z.wrap(a)
-    a0, a1 = _lz_w(la_, 0), _lz_w(la_, 1)
+    return Z.canon(_fq12_sqr_post(Z.mul(*_fq12_sqr_pre(Z.wrap(a)))))
+
+
+def _fq12_sqr_pre(a: Z.LZ):
+    """Complex-squaring operand stacking, flattened down to the
+    Fp-level multiplicand pair (2 Fq6 muls = 36 Fp products)."""
+    a0, a1 = _lz_w(a, 0), _lz_w(a, 1)
     la = Z.stack([Z.add(a0, a1), a0], axis=-4)
     lb = Z.stack([Z.add(a0, _fq6_v_lz(a1)), a1], axis=-4)
-    t = _fq6_mul_lz(la, lb)
+    return _fq6_mul_pre(la, lb)
+
+
+def _fq12_sqr_post(tp: Z.LZ) -> Z.LZ:
+    """Fp-level products -> squared Fq12 value (lazy — callers canon
+    at their own boundary)."""
+    t = _fq6_mul_post(tp)
     t01, t0a1 = _lz_w(t, 0), _lz_w(t, 1)
     # t01 = a0^2 + a0*a1*(1+v) + v*a1^2 ; c0 = a0^2 + v a1^2
     c0 = Z.sub(Z.sub(t01, t0a1), _fq6_v_lz(t0a1))
     c1 = Z.mul_small(t0a1, 2)
-    return Z.canon(Z.stack([c0, c1], axis=-4))
+    return Z.stack([c0, c1], axis=-4)
 
 
 @jax.jit

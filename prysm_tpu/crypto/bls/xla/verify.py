@@ -26,7 +26,7 @@ from ..pure import curve as pc
 from . import limbs as L
 from . import tower as T
 from .curve import (
-    FP_OPS, FQ2_OPS, g1_to_affine, g2_to_affine, pack_g1_points,
+    FP_OPS, FQ2_OPS, g1_to_affine, g2_to_affine,
     point_sum_tree, scalar_mul_windowed_glv,
     scalar_bits_from_ints, point_select, point_inf_like,
 )
@@ -39,8 +39,15 @@ NEG_G1_GEN = (pc.G1_GEN[0], -pc.G1_GEN[1])
 
 
 def _neg_g1_affine():
-    x, y, _ = pack_g1_points([NEG_G1_GEN])
-    return x[0], y[0]
+    # HOST integer math end-to-end, returning numpy: this constant is
+    # built inside traced functions (including the shard_map body of
+    # the sharded slot verify), where a concrete jax array committed
+    # to one device conflicts with a multi-device mesh and any jnp op
+    # (even indexing) yields a tracer.  A numpy constant embeds as a
+    # replicated literal everywhere.
+    x = L.int_to_limbs_np((NEG_G1_GEN[0].n * L.R_MOD_P) % L.P)
+    y = L.int_to_limbs_np((NEG_G1_GEN[1].n * L.R_MOD_P) % L.P)
+    return x, y
 
 
 def _batch_affine(g1_jac, g2_jac):
@@ -303,9 +310,22 @@ def _sharded_slot_verify_traced(mesh, pk_jac, sig_jac, h_jac, r_bits):
         r_apk = scalar_mul_windowed_glv(FP_OPS, apk, rb)
         r_sig = scalar_mul_windowed_glv(FQ2_OPS, sig, rb)
         s_part = point_sum_tree(FQ2_OPS, r_sig)
-        (ax, ay, a_inf), (hx, hy, _) = _batch_affine(r_apk, h)
-        f = miller_loop((ax, ay), (hx, hy))
-        f = T.fq12_select(~a_inf, f, T.fq12_one_like(f))
+        # ONE shared Miller ladder per shard: bilinearity in the
+        # second argument gives e(-g1, S) = prod_d e(-g1, S_d), so the
+        # (-g1, [r]sig-sum) lane folds into each shard's local pair
+        # batch instead of a second full 63-step scan after the
+        # cross-device combine.  The lane rides the shared Fermat
+        # inversion too (g2_all), and masks out when the LOCAL partial
+        # sum is infinity (its pairing factor is 1).
+        g2_all = tuple(jnp.concatenate([t_s[None], t_h], axis=0)
+                       for t_s, t_h in zip(s_part, h))
+        (ax, ay, a_inf), (qx, qy, q_inf) = _batch_affine(r_apk, g2_all)
+        ng_x, ng_y = _neg_g1_affine()
+        p_x = jnp.concatenate([ng_x[None], ax], axis=0)
+        p_y = jnp.concatenate([ng_y[None], ay], axis=0)
+        mask = jnp.concatenate([~q_inf[:1], ~a_inf], axis=0)
+        f = miller_loop((p_x, p_y), (qx, qy))
+        f = T.fq12_select(mask, f, T.fq12_one_like(f))
         f_part = fq12_prod_tree(f)
         return f_part[None], tuple(t[None] for t in s_part)
 
@@ -317,14 +337,14 @@ def _sharded_slot_verify_traced(mesh, pk_jac, sig_jac, h_jac, r_bits):
         check_rep=False,
     )(tuple(jnp.moveaxis(t, 0, 1) for t in pk_jac), sig_jac, h_jac,
       r_bits)
-    # combine: global [r]sig sum and global Fq12 product
+    # combine: ONE Fq12 product + ONE final exponentiation; no second
+    # Miller scan and no affine conversion — the global [r]sig sum is
+    # needed only for the fail-closed infinity check, read directly
+    # off its Jacobian Z
     s = point_sum_tree(FQ2_OPS, s_parts)
-    sx, sy, s_inf = g2_to_affine(tuple(t[None] for t in s))
-    ng_x, ng_y = _neg_g1_affine()
-    f_neg = miller_loop((ng_x[None], ng_y[None]), (sx, sy))
-    f = jnp.concatenate([f_parts, f_neg], axis=0)
-    out = final_exponentiation_check(fq12_prod_tree(f))
-    return is_fq12_one(out) & ~s_inf[0]
+    s_inf = T.fq2_is_zero(s[2])
+    out = final_exponentiation_check(fq12_prod_tree(f_parts))
+    return is_fq12_one(out) & ~s_inf
 
 
 def random_rlc_bits(n: int, rng=None, nbits: int = 64) -> jnp.ndarray:

@@ -59,6 +59,15 @@ _BASE: dict[str, tuple[str, str]] = {
                  "guard)"),
     "jit_backend_compile_seconds": (
         HISTOGRAM, "per-compile XLA backend compile latency"),
+    # --- shared Miller ladder / Pallas tower backend (PR 9)
+    "pairing_ladder_pairs": (
+        COUNTER, "pairs driven through the shared slot Miller ladder "
+                 "(live attestations + the (-g1, S) lane)"),
+    "pallas_tower_dispatches": (
+        COUNTER, "Pallas Montgomery/tower kernel call sites traced "
+                 "into device graphs"),
+    "tower_backend_selections": (
+        COUNTER, "Montgomery-mul backend flips (xla <-> pallas)"),
     # --- registry pubkey table (PR 1-2)
     "pubkey_table_rows": (GAUGE, "device-resident pubkey table rows"),
     "pubkey_table_rows_synced": (
@@ -140,6 +149,8 @@ BENCH_STAMPED: tuple[str, ...] = (
     "bisection_device_verifies", "bisection_isolations",
     "fail_closed_abandons", "reorgs_applied", "slashings_injected",
     "registry_churn_events", "soak_slots",
+    "pairing_ladder_pairs", "pallas_tower_dispatches",
+    "tower_backend_selections",
 )
 
 for _n in BENCH_STAMPED:

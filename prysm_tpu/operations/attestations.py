@@ -435,11 +435,15 @@ class IndexedSlotBatch:
         host packing with this in-flight dispatch."""
         from ..analysis.transfer import dispatch_guard
         from ..crypto.bls.xla.verify import fused_slot_verify_device
+        from ..monitoring.metrics import metrics as _m
         from ..runtime import faults as _faults
 
         if len(self) == 0:
             return True
         _faults.fire("device_dispatch")
+        # the shared ladder runs one pair per live attestation plus
+        # the (-g1, [r]sig-sum) lane
+        _m.inc("pairing_ladder_pairs", len(self) + 1)
         args = self.device_args(rng)
         # host-transfer sanitizer (analysis/transfer.py): armed under
         # PRYSM_TPU_SANITIZE, the fused dispatch itself must not move

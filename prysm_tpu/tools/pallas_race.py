@@ -12,9 +12,39 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import sys
 import time
 
 from ..utils import jaxenv
+
+
+def _arm_budget() -> None:
+    """Kill the race after ``PRYSM_RACE_BUDGET`` seconds (0 = off).
+
+    Partial results are still flushed: the handler writes whatever is
+    in RACE_SO_FAR before exiting, so a race that blows its budget on
+    one pathological compile still reports the entries it finished."""
+    budget = int(os.environ.get("PRYSM_RACE_BUDGET", "0"))
+    if budget <= 0:
+        return
+
+    def on_alarm(signum, frame):
+        RACE_SO_FAR["budget_exceeded_s"] = budget
+        out = json.dumps(RACE_SO_FAR)
+        print(out, flush=True)
+        with open(os.path.join(jaxenv.REPO_ROOT, "PALLAS_RACE.json"),
+                  "w") as fh:
+            fh.write(out + "\n")
+        print(f"pallas_race: budget of {budget}s exceeded, "
+              "partial results written", file=sys.stderr)
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(budget)
+
+
+RACE_SO_FAR: dict = {}
 
 
 def _med(fn, variants, iters=5, warmup=2):
@@ -37,6 +67,7 @@ def _med(fn, variants, iters=5, warmup=2):
 
 def main() -> None:
     jaxenv.use_cache(jaxenv.TPU_CACHE)
+    _arm_budget()
     import jax
     import numpy as np
 
@@ -45,7 +76,8 @@ def main() -> None:
     from ..crypto.bls.xla.pallas_mont import mont_mul_pallas
     from ..crypto.bls.xla.pallas_tower import fq12_mul_pallas
 
-    results: dict = {"backend": jax.default_backend()}
+    results: dict = RACE_SO_FAR
+    results["backend"] = jax.default_backend()
 
     # correctness on the COMPILED kernel path (not interpret)
     a = L.rand_canonical(21, (256,))
@@ -104,7 +136,12 @@ def main() -> None:
     def pallas_fq12(x, y):
         return fq12_mul_pallas(x, y, interpret=False)
 
-    for name, shape in (("b8192", (8192,)), ("b256", (256,))):
+    # b3168 = 48 fp products x 66 lanes: the stage-1 width of one
+    # merged-ladder doubling step (65 attestation pairs + the
+    # (-g1, S) lane) after the PR-9 wide-step restructure — the shape
+    # every mul_wide dispatch actually presents to the backend.
+    for name, shape in (("b8192", (8192,)), ("b3168", (3168,)),
+                        ("b256", (256,))):
         results[f"fp_mul_xla_{name}_us_per_op"] = round(
             per_op_us(xla_fp, 100, shape), 2)
         results[f"fp_mul_pallas_{name}_us_per_op"] = round(

@@ -30,7 +30,13 @@ import time
 from ..utils import jaxenv
 
 
-def _time(fn, variants, iters=5, warmup=2):
+def _time(fn, variants, iters=7, warmup=2):
+    """Best-of-k: the MINIMUM over ``iters`` runs.  The rpc floor's
+    noise is strictly additive, so the median still let one prefix
+    catch a quiet window while its neighbor caught a noisy one —
+    which is how r04's BREAKDOWN attributed -31.47 ms to scalar_mul
+    (its prefix "measured" below the floor prefix).  The minimum is
+    the robust estimator under nonnegative noise."""
     import numpy as np
 
     times = []
@@ -41,8 +47,7 @@ def _time(fn, variants, iters=5, warmup=2):
         t0 = time.perf_counter()
         np.asarray(fn(*a))
         times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2]
+    return min(times)
 
 
 def main() -> None:
@@ -145,10 +150,23 @@ def main() -> None:
     results: dict[str, object] = {
         "prefix_ms": {k: round(v, 2) for k, v in raw.items()}}
     order = [n for n, _ in prefixes]
+    # each prefix computes a superset of the previous one, so TRUE
+    # prefix times are monotone nondecreasing; project the
+    # measurements onto that constraint (running max) and clamp every
+    # stage delta at 0 — residual noise then shows up as a zero-cost
+    # stage instead of a negative one
+    mono: dict[str, float] = {}
+    running = 0.0
+    for n in order:
+        running = max(running, raw[n])
+        mono[n] = running
     for prev, cur in zip(order, order[1:]):
-        results[f"{cur}_ms"] = round(raw[cur] - raw[prev], 2)
+        results[f"{cur}_ms"] = round(max(0.0, mono[cur] - mono[prev]), 2)
     results["full_slot_ms"] = round(raw["final_exp"], 2)
-    results["device_compute_ms"] = round(raw["final_exp"] - raw["floor"], 2)
+    results["device_compute_ms"] = round(
+        max(0.0, mono["final_exp"] - mono["floor"]), 2)
+    results["timing"] = ("best-of-7 prefix timings; stage deltas from "
+                         "the monotone envelope, clamped at >= 0")
     results["shape"] = f"{C}x{K}"
     results["backend"] = jax.default_backend()
     out = json.dumps(results)

@@ -53,3 +53,23 @@ def test_sharded_tampered_pubkey_rejected(mesh, slot_batch):
     ok = sharded_slot_verify(mesh, pk_bad, slot_batch["sig_jac"],
                              slot_batch["h_jac"], slot_batch["r_bits"])
     assert not bool(ok)
+
+
+def test_sharded_one_ladder_per_shard(mesh, slot_batch):
+    """PR-9 regression (trace only): the sharded slot verify runs ONE
+    Miller scan — inside the shard_map body, where the (-g1, S_d) lane
+    rides each shard's local batch — and ONE final exponentiation in
+    the cross-device combine.  The pre-restructure graph had a second
+    full ladder after the combine for e(-g1, S)."""
+    from prysm_tpu.crypto.bls.xla import probe
+    from prysm_tpu.crypto.bls.xla.verify import (
+        _sharded_slot_verify_traced,
+    )
+
+    def fn(pk, sig, h, rb):
+        return _sharded_slot_verify_traced(mesh, pk, sig, h, rb)
+
+    counts = probe.miller_final_exp_counts(
+        fn, slot_batch["pk_jac"], slot_batch["sig_jac"],
+        slot_batch["h_jac"], slot_batch["r_bits"])
+    assert counts == (1, 1)

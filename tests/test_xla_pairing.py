@@ -104,3 +104,68 @@ class TestPairing:
         q = pc.multiply(pc.G2_GEN, rng.randrange(1, R))
         assert (xp.multi_pairing([(p, q), (None, q), (p, None)])
                 == pp.pairing(p, q))
+
+    def test_merged_batch_with_masked_entries(self, rng):
+        """A random merged pair batch with infinity-masked lanes
+        interleaved — the shape the shared slot ladder actually runs
+        (live attestations + the (-g1, S) lane + dead lanes) — matches
+        the pure golden product over the LIVE pairs only."""
+        pairs, live = [], []
+        for i in range(6):
+            p = pc.multiply(pc.G1_GEN, rng.randrange(1, R))
+            q = pc.multiply(pc.G2_GEN, rng.randrange(1, R))
+            if i in (1, 4):                 # masked lanes
+                pairs.append((None, q) if i == 1 else (p, None))
+            else:
+                pairs.append((p, q))
+                live.append((p, q))
+        assert xp.multi_pairing(pairs) == pp.multi_pairing(live)
+
+
+class TestOneLadder:
+    """PR-9 regression: the merged multi-pairing restructure must keep
+    every verify graph at exactly ONE 63-step Miller scan and ONE
+    final exponentiation — counted off the jaxpr, so a refactor that
+    quietly reintroduces a second ladder fails here without ever
+    compiling (probe.py documents the scan signatures)."""
+
+    def test_pairing_check_one_ladder(self):
+        import jax.numpy as jnp
+
+        from prysm_tpu.crypto.bls.xla import limbs as L
+        from prysm_tpu.crypto.bls.xla import probe
+        from prysm_tpu.crypto.bls.xla.verify import _pairing_check
+
+        p_x = L.rand_canonical(1, (3,))
+        p_y = L.rand_canonical(2, (3,))
+        q_x = L.rand_canonical(3, (3, 2))
+        q_y = L.rand_canonical(4, (3, 2))
+        mask = jnp.ones((3,), bool)
+        assert probe.miller_final_exp_counts(
+            _pairing_check, p_x, p_y, q_x, q_y, mask) == (1, 1)
+
+    def test_fused_slot_verify_one_ladder(self):
+        """The WHOLE pool->verdict fused dispatch — decompress + h2c +
+        gather/aggregate + RLC check — still one Miller scan and one
+        final exp (trace only; tiny structural shapes)."""
+        import jax.numpy as jnp
+
+        from prysm_tpu.crypto.bls.xla import probe
+        from prysm_tpu.crypto.bls.xla.verify import (
+            fused_slot_verify_device,
+        )
+
+        N, A, K, nbits = 4, 2, 2, 8
+
+        def zu(*s):
+            return jnp.zeros(s, jnp.uint32)
+
+        counts = probe.miller_final_exp_counts(
+            fused_slot_verify_device,
+            zu(N, 24), zu(N, 24), jnp.zeros((N,), bool),
+            jnp.zeros((A, K), jnp.int32), jnp.ones((A, K), bool),
+            zu(A, 2, 24), jnp.zeros((A,), bool),
+            jnp.zeros((A,), bool), jnp.ones((A,), bool),
+            zu(A, 2, 24), zu(A, 2, 24), zu(nbits, A),
+            jnp.ones((A,), bool))
+        assert counts == (1, 1)
