@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow lint chaos stream soak warm-cache dryrun bench native proto race
+.PHONY: test test-slow lint chaos stream soak trace warm-cache dryrun bench native proto race
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -55,6 +55,15 @@ stream:
 soak:
 	$(PY) -m pytest tests/test_soak.py -q -m "soak or not soak" -x
 	PRYSM_TIER_BUDGET=900 $(PY) bench.py --tier soak
+
+# Observability artifact (ISSUE 11): a short traced soak with the
+# flight recorder armed — writes TRACE_SOAK.json (load at
+# https://ui.perfetto.dev or chrome://tracing), dumps flight-recorder
+# black boxes into .flight/, and prints the per-stage latency
+# quantiles + time-to-first-verdict summary.
+trace:
+	$(PY) -m prysm_tpu.tools.trace_report --soak 64 \
+		--out TRACE_SOAK.json --flight-dir .flight
 
 # Populate the fingerprint-keyed CPU compile cache on THIS host.
 # Per-file processes keep each run's compile count low enough that

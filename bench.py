@@ -765,7 +765,9 @@ def main() -> None:
             # megabatch counters expose the scheduler's decisions the
             # same way (every flush/bisect/demotion is a metric).
             from prysm_tpu.monitoring.metrics import metrics as _m
-            from prysm_tpu.monitoring.registry import BENCH_STAMPED
+            from prysm_tpu.monitoring.registry import (
+                BENCH_STAMPED, BENCH_STAMPED_QUANTILES,
+            )
 
             result["degraded_dispatches"] = \
                 _m.counter("degraded_dispatches").value
@@ -774,6 +776,17 @@ def main() -> None:
                 v = _m.counter(mname).value
                 if v:
                     result[mname] = v
+            # per-stage latency breakdowns next to the counter totals:
+            # p50/p90/p99 of every non-empty stage histogram
+            for hname in BENCH_STAMPED_QUANTILES:
+                h = _m.histogram(hname)
+                if h.n:
+                    result[hname] = {
+                        "n": h.n,
+                        "p50": h.quantile(0.5),
+                        "p90": h.quantile(0.9),
+                        "p99": h.quantile(0.99),
+                    }
             print(json.dumps(result))
         except BaseException as e:   # noqa: BLE001 — child boundary
             print(f"# tier {sys.argv[2]} failed: {e!r}",

@@ -20,6 +20,10 @@ and fused-dispatch work left implicit:
   ``monitoring/registry.py`` with the right kind, and every declared
   name must be used: a typo'd counter silently mints a forever-zero
   twin, and a dead declaration is a lie in the scrape surface.
+* :class:`SpanRegistryChecker` — every trace-span name opened
+  (``monitoring.tracing.span("...")``) must be declared in
+  ``monitoring/registry.py`` ``SPANS`` and vice versa, the span-
+  taxonomy mirror of the metrics check.
 * :class:`FaultSeamChecker` — every fault-injection point fired must
   be registered in ``runtime/faults.py`` and every registered point
   must be fired somewhere: an unregistered seam can never be
@@ -537,6 +541,59 @@ class MetricsRegistryChecker(Checker):
         return self._findings
 
 
+# --- span-registry checker --------------------------------------------------
+
+
+class SpanRegistryChecker(Checker):
+    """Mirror of :class:`MetricsRegistryChecker` for trace spans:
+    every ``span("...")`` name opened anywhere in the tree must be
+    declared in ``monitoring/registry.py`` ``SPANS`` and every
+    declared name must be opened somewhere.  A typo'd span name
+    silently traces a series nothing ever queries; a dead declaration
+    is a lie in the span taxonomy."""
+
+    name = "span-registry"
+
+    REGISTRY_PATH = "prysm_tpu/monitoring/registry.py"
+
+    def __init__(self, declared: dict[str, str] | None = None):
+        if declared is None:
+            from ..monitoring.registry import SPANS
+            declared = SPANS
+        self._declared = declared
+        self._used: dict[str, tuple[str, int]] = {}
+        self._findings: list[Finding] = []
+
+    def visit_module(self, path: str, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            f = node.func
+            is_span = (isinstance(f, ast.Name) and f.id == "span") or (
+                isinstance(f, ast.Attribute) and f.attr == "span")
+            if not is_span:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str):
+                self._used.setdefault(arg.value, (path, node.lineno))
+
+    def finalize(self) -> list[Finding]:
+        for name, (path, line) in sorted(self._used.items()):
+            if name not in self._declared:
+                self._findings.append(Finding(
+                    self.name, path, line,
+                    f"span {name!r} is not declared in "
+                    f"monitoring/registry.py SPANS (typo traces a "
+                    f"series nothing queries)"))
+        for name in sorted(set(self._declared) - set(self._used)):
+            self._findings.append(Finding(
+                self.name, self.REGISTRY_PATH, 0,
+                f"declared span {name!r} is never opened anywhere in "
+                f"the tree (dead span)"))
+        return self._findings
+
+
 # --- fault-seam checker -----------------------------------------------------
 
 
@@ -664,8 +721,8 @@ class DeadImportChecker(Checker):
 def default_checkers() -> list[Checker]:
     """The full gate, wired to the real declared registries."""
     return [JitHazardChecker(), RecompileHazardChecker(),
-            MetricsRegistryChecker(), FaultSeamChecker(),
-            DeadImportChecker()]
+            MetricsRegistryChecker(), SpanRegistryChecker(),
+            FaultSeamChecker(), DeadImportChecker()]
 
 
 def run_tree() -> list[Finding]:

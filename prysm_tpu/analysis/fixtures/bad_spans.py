@@ -1,0 +1,12 @@
+"""SEEDED VIOLATIONS for SpanRegistryChecker — parsed, never
+imported."""
+
+
+def trace(tracing, block):
+    # span-registry: typo'd span name (declared name is
+    # 'chain.receive_block') traces a series nothing queries
+    with tracing.span("chain.receive_blonk"):
+        pass
+    # NOT a finding: declared span opened under its declared name
+    with tracing.span("pool.ingress"):
+        pass

@@ -81,6 +81,13 @@ class BlockchainService:
     def receive_block(self, signed_block, verify_signatures: bool = True):
         """ReceiveBlock/onBlock analog.  Raises BlockProcessingError
         on any invalid block."""
+        from ..monitoring import tracing as _tracing
+
+        with _tracing.span("chain.receive_block",
+                           slot=signed_block.message.slot):
+            return self._receive_block(signed_block, verify_signatures)
+
+    def _receive_block(self, signed_block, verify_signatures: bool = True):
         t0 = time.perf_counter()
         block = signed_block.message
         block_root = type(block).hash_tree_root(block)

@@ -35,6 +35,7 @@ memory.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 
 _PENDING = object()
@@ -50,6 +51,9 @@ class SlotDispatcher:
         self._next_result = 0
         # ticket -> ("ok", device_value) | ("err", exc) | resolved bool
         self._entries: OrderedDict[int, object] = OrderedDict()
+        # ticket -> perf_counter at successful dispatch (device-compute
+        # stage timing: submit -> verdict materialized)
+        self._t_submit: dict[int, float] = {}
         self._closed = False
 
     # --- producer side -----------------------------------------------------
@@ -77,11 +81,15 @@ class SlotDispatcher:
             value = ("err", e)
         with self._lock:
             self._entries[ticket] = value
+            if value[0] == "ok":
+                self._t_submit[ticket] = time.perf_counter()
         return ticket
 
     def _drain_oldest(self) -> None:
         import numpy as np
 
+        from ....monitoring import tracing as _tracing
+        from ....monitoring.metrics import metrics as _m
         from ....runtime import faults as _faults
 
         with self._lock:
@@ -93,14 +101,24 @@ class SlotDispatcher:
             if target is None:
                 return
             tag, dev = self._entries[target]
+            t_sub = self._t_submit.pop(target, None)
+        t0 = time.perf_counter()
         try:
-            resolved = bool(np.asarray(_faults.fire(
-                "partial_readback", _faults.fire("readback", dev))))
+            with _tracing.span("dispatch.readback"):
+                resolved = bool(np.asarray(_faults.fire(
+                    "partial_readback",
+                    _faults.fire("readback", dev))))
         except Exception as e:      # noqa: BLE001 — repropagated
             # a failed buffer-bound readback belongs to the DRAINED
             # ticket, not the submit that triggered the drain: store
             # it so result(target) re-raises (or resubmit recovers it)
             resolved = ("err", e)
+        else:
+            done = time.perf_counter()
+            _m.observe("stage_readback_seconds", done - t0)
+            if t_sub is not None:
+                _m.observe("stage_device_compute_seconds",
+                           done - t_sub)
         with self._lock:
             if self._entries.get(target, _ABANDONED) is not _ABANDONED:
                 self._entries[target] = resolved
@@ -116,6 +134,8 @@ class SlotDispatcher:
         caller's bookkeeping bug."""
         import numpy as np
 
+        from ....monitoring import tracing as _tracing
+        from ....monitoring.metrics import metrics as _m
         from ....runtime import faults as _faults
 
         with self._lock:
@@ -127,6 +147,7 @@ class SlotDispatcher:
             if ticket not in self._entries:
                 raise KeyError(f"unknown ticket {ticket}")
             entry = self._entries.pop(ticket)
+            t_sub = self._t_submit.pop(ticket, None)
             self._next_result += 1
         if entry is _ABANDONED:
             return False                 # fail-closed
@@ -135,8 +156,16 @@ class SlotDispatcher:
         tag, payload = entry
         if tag == "err":
             raise payload
-        return bool(np.asarray(_faults.fire(
-            "partial_readback", _faults.fire("readback", payload))))
+        t0 = time.perf_counter()
+        with _tracing.span("dispatch.readback"):
+            ok = bool(np.asarray(_faults.fire(
+                "partial_readback",
+                _faults.fire("readback", payload))))
+        done = time.perf_counter()
+        _m.observe("stage_readback_seconds", done - t0)
+        if t_sub is not None:
+            _m.observe("stage_device_compute_seconds", done - t_sub)
+        return ok
 
     def failed(self, ticket: int):
         """Peek at ``ticket``'s captured exception (or None) WITHOUT
@@ -170,6 +199,10 @@ class SlotDispatcher:
             if cur is _PENDING or cur is _ABANDONED:
                 return False    # claimed or abandoned while re-running
             self._entries[ticket] = value
+            if value[0] == "ok":
+                self._t_submit[ticket] = time.perf_counter()
+            else:
+                self._t_submit.pop(ticket, None)
         from ....monitoring.metrics import metrics as _m
 
         _m.inc("dispatch_resubmits")
@@ -184,10 +217,14 @@ class SlotDispatcher:
                          and self._entries[ticket] is not _ABANDONED)
             if abandoned:
                 self._entries[ticket] = _ABANDONED
+                self._t_submit.pop(ticket, None)
         if abandoned:
+            from ....monitoring import flight as _flight
             from ....monitoring.metrics import metrics as _m
 
             _m.inc("fail_closed_abandons")
+            _flight.note("ticket_abandoned", ticket=ticket)
+            _flight.dump("fail_closed_abandon")
         return 1 if abandoned else 0
 
     def pending(self) -> int:
@@ -208,8 +245,12 @@ class SlotDispatcher:
                 if self._entries[t] is not _ABANDONED:
                     self._entries[t] = _ABANDONED
                     abandoned += 1
+            self._t_submit.clear()
         if abandoned:
+            from ....monitoring import flight as _flight
             from ....monitoring.metrics import metrics as _m
 
             _m.inc("fail_closed_abandons", abandoned)
+            _flight.note("dispatcher_closed", abandoned=abandoned)
+            _flight.dump("fail_closed_abandon")
         return abandoned

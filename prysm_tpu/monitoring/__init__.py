@@ -1,15 +1,22 @@
-"""Monitoring: metrics registry + tracing spans.
+"""Monitoring: metrics registry + tracing spans + flight recorder.
 
 Reference analog: ``monitoring/prometheus`` + ``monitoring/tracing``
-(opencensus) [U, SURVEY.md §2 "monitoring", §5].
+(opencensus) [U, SURVEY.md §2 "monitoring", §5].  The flight recorder
+(``flight.py``) is the chaos/soak black box: a bounded ring of recent
+pipeline events dumped to JSON on breaker trips, fault injections and
+fail-closed abandons.
 """
 
 from .metrics import (
     Counter, Gauge, Histogram, MetricsRegistry, metrics,
     prometheus_registry, serve_prometheus,
 )
-from .tracing import span, enable_jax_trace
+from .tracing import (
+    enable_jax_trace, enable_tracing, mark_first_verdict, span,
+    tracing_enabled,
+)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "metrics", "prometheus_registry", "serve_prometheus",
-           "span", "enable_jax_trace"]
+           "span", "enable_jax_trace", "enable_tracing",
+           "tracing_enabled", "mark_first_verdict"]

@@ -1,0 +1,169 @@
+"""Flight recorder: the verify pipeline's black box.
+
+A chaos or soak failure is only as debuggable as what was captured in
+the seconds BEFORE it — after the breaker trips, the interesting
+history is already gone from live metrics (counters only say how
+often, never in what order).  This module keeps a bounded ring of
+recent pipeline events and, on the triggers that matter (breaker
+trips, fault injections, fail-closed abandons), dumps a JSON black
+box: the event ring, the newest span records from the tracing ring,
+a full metrics snapshot, and the counter deltas since the previous
+dump.
+
+Cost model mirrors ``runtime/faults.fire`` and ``tracing.span``:
+disarmed (the production default), :func:`note` and :func:`dump` are
+one module-global branch each.  Arm via ``PRYSM_TPU_FLIGHT_DIR`` (read
+once at import) or :func:`arm` (tests, ``make trace``).  Dumps are
+rate-limited (``min_interval_s``) so a fault storm can't turn the
+recorder into a disk DoS, and rotated (``keep`` newest files stay).
+Every dump increments ``flight_recorder_dumps``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+DIR_ENV = "PRYSM_TPU_FLIGHT_DIR"
+RING_ENV = "PRYSM_TPU_FLIGHT_RING"
+MIN_S_ENV = "PRYSM_TPU_FLIGHT_MIN_S"
+
+#: span records included per dump (tail of the tracing ring)
+_SPAN_TAIL = 256
+
+_armed = False
+_dir: str | None = None
+_min_interval_s = 1.0
+_keep = 8
+_lock = threading.Lock()
+_events: deque = deque(
+    maxlen=max(1, int(os.environ.get(RING_ENV, "512"))))
+_last_dump = 0.0          # monotonic; 0 == never
+_seq = 0
+_last_counters: dict[str, float] = {}
+
+
+def arm(directory: str, min_interval_s: float | None = None,
+        keep: int = 8) -> None:
+    """Arm the recorder: events accumulate and triggers dump JSON
+    black boxes into ``directory`` (created if missing)."""
+    global _armed, _dir, _min_interval_s, _keep, _last_dump
+    os.makedirs(directory, exist_ok=True)
+    with _lock:
+        _dir = directory
+        _keep = max(1, int(keep))
+        if min_interval_s is not None:
+            _min_interval_s = float(min_interval_s)
+        _last_dump = 0.0
+        _armed = True
+
+
+def disarm() -> None:
+    global _armed
+    with _lock:
+        _armed = False
+        _events.clear()
+
+
+def armed() -> bool:
+    return _armed
+
+
+def note(kind: str, **attrs) -> None:
+    """Append one event to the ring.  Disarmed: one branch."""
+    if not _armed:
+        return
+    ev = {"t": time.time(), "kind": kind, **attrs}
+    with _lock:
+        _events.append(ev)
+
+
+def snapshot(trigger: str = "snapshot") -> dict:
+    """The black-box payload (also served at ``/debug/flight``):
+    armed state, event ring, recent spans, metrics snapshot, counter
+    deltas since the last written dump."""
+    from . import tracing
+    from .metrics import metrics
+
+    with _lock:
+        events = list(_events)
+    metric_snap = metrics.snapshot()
+    counters = {k: v["value"] for k, v in metric_snap.items()
+                if v["kind"] == "counter"}
+    with _lock:
+        deltas = {k: v - _last_counters.get(k, 0.0)
+                  for k, v in counters.items()
+                  if v - _last_counters.get(k, 0.0)}
+    return {
+        "trigger": trigger,
+        "unix_time": time.time(),
+        "armed": _armed,
+        "events": events,
+        "spans": tracing.records()[-_SPAN_TAIL:],
+        "metrics": metric_snap,
+        "counter_deltas": deltas,
+    }
+
+
+def dump(trigger: str, force: bool = False) -> str | None:
+    """Write one black-box JSON file for ``trigger``; returns its path
+    (None when disarmed or rate-limited).  ``force`` bypasses the rate
+    limit — breaker trips and fail-closed abandons are rare enough to
+    always deserve a file; per-fault dumps inside a storm are not."""
+    global _last_dump, _seq
+    if not _armed:
+        return None
+    now = time.monotonic()
+    with _lock:
+        if _dir is None:
+            return None
+        if not force and _last_dump and (
+                now - _last_dump) < _min_interval_s:
+            return None
+        _last_dump = now
+        seq = _seq
+        _seq += 1
+        directory, keep = _dir, _keep
+    payload = snapshot(trigger)
+    with _lock:
+        _last_counters.clear()
+        _last_counters.update(
+            {k: v["value"] for k, v in payload["metrics"].items()
+             if v["kind"] == "counter"})
+    safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                   for c in trigger)
+    path = os.path.join(directory, f"flight-{seq:04d}-{safe}.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    _rotate(directory, keep)
+    from .metrics import metrics
+
+    metrics.inc("flight_recorder_dumps")
+    return path
+
+
+def _rotate(directory: str, keep: int) -> None:
+    try:
+        dumps = sorted(
+            fn for fn in os.listdir(directory)
+            if fn.startswith("flight-") and fn.endswith(".json"))
+    except OSError:
+        return
+    for fn in dumps[:-keep]:
+        try:
+            os.remove(os.path.join(directory, fn))
+        except OSError:
+            pass
+
+
+def _arm_from_env() -> None:
+    directory = os.environ.get(DIR_ENV)
+    if directory:
+        arm(directory,
+            min_interval_s=float(os.environ.get(MIN_S_ENV, "1.0")))
+
+
+_arm_from_env()

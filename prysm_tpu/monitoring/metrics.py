@@ -142,6 +142,27 @@ class MetricsRegistry:
             parts = [m.render() for _, m in sorted(self._metrics.items())]
         return "".join(parts)
 
+    def snapshot(self) -> dict[str, dict]:
+        """JSON-friendly point-in-time view of every registered metric
+        (the flight recorder's ``metrics`` payload): counters/gauges
+        carry their value, histograms their count/sum + reservoir
+        p50/p90/p99."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out: dict[str, dict] = {}
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = {"kind": "counter", "value": m.value}
+            elif isinstance(m, Gauge):
+                out[name] = {"kind": "gauge", "value": m.value}
+            elif isinstance(m, Histogram):
+                out[name] = {
+                    "kind": "histogram", "n": m.n, "sum": m.total,
+                    "p50": m.quantile(0.5), "p90": m.quantile(0.9),
+                    "p99": m.quantile(0.99),
+                }
+        return out
+
 
 # process-global default registry (reference uses the prometheus
 # default registerer the same way)

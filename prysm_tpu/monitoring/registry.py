@@ -101,6 +101,24 @@ _BASE: dict[str, tuple[str, str]] = {
     "slashings_injected": (
         COUNTER, "surround-vote slashings flooded into the pool"),
     "soak_slots": (COUNTER, "slots processed by the soak harness"),
+    # --- slot-lifecycle stage seams + flight recorder (PR 11)
+    "flight_recorder_dumps": (
+        COUNTER, "flight-recorder black-box JSON dumps written"),
+    "megabatch_linger_seconds": (
+        HISTOGRAM, "oldest-slot wait from enqueue to megabatch flush"),
+    "stage_demux_seconds": (
+        HISTOGRAM, "per-slot verdict demux of a drained ticket"),
+    "stage_device_compute_seconds": (
+        HISTOGRAM, "fused dispatch submit -> verdict materialized"),
+    "stage_host_pack_seconds": (
+        HISTOGRAM, "host packing of device args (parse/h2c/pad)"),
+    "stage_queue_wait_seconds": (
+        HISTOGRAM, "per-slot wait in the megabatch accumulator queue"),
+    "stage_readback_seconds": (
+        HISTOGRAM, "blocking device->host verdict readback"),
+    "time_to_first_verdict_seconds": (
+        GAUGE, "process start -> first pipeline verdict (cold-start "
+               "metric of record)"),
     # --- node / services
     "block_processing_seconds": (
         HISTOGRAM, "per-block processing latency (blockchain service)"),
@@ -153,7 +171,42 @@ BENCH_STAMPED: tuple[str, ...] = (
     "tower_backend_selections",
 )
 
+#: histograms bench.py stamps into each tier's JSON as p50/p90/p99
+#: when non-empty — the per-stage latency breakdown next to the
+#: counter totals.  Every name must be a declared histogram.
+BENCH_STAMPED_QUANTILES: tuple[str, ...] = (
+    "stage_queue_wait_seconds", "stage_host_pack_seconds",
+    "stage_device_compute_seconds", "stage_readback_seconds",
+    "stage_demux_seconds", "megabatch_linger_seconds",
+    "megabatch_amortized_slot_seconds", "slot_verify_latency_seconds",
+)
+
+#: every declared span name (the slot-lifecycle trace taxonomy) ->
+#: one-line help.  ``monitoring/tracing.span("...")`` call sites are
+#: checked against this both directions by the static-analysis gate
+#: (analysis/astlint.SpanRegistryChecker), exactly like metric names:
+#: a typo'd span silently traces nothing, a dead declaration is a lie
+#: in the taxonomy.
+SPANS: dict[str, str] = {
+    "chain.receive_block": "blockchain service whole-block path",
+    "dispatch.device": "fused verify dispatch (async, un-read-back)",
+    "dispatch.pack": "host packing of the fused dispatch args",
+    "dispatch.readback": "blocking device->host verdict readback",
+    "node.slot": "per-slot node duties tick",
+    "pool.build": "indexed slot-batch build from the pool",
+    "pool.ingress": "attestation pool ingest",
+    "sched.bisect": "on-device megabatch bisection rung",
+    "sched.demux": "per-slot verdict demux of a drained ticket",
+    "sched.flush": "megabatch dispatch as one fused ticket",
+    "sched.submit": "slot submission into the accumulator",
+    "sync.slot_batch": "per-slot pooled-attestation verify",
+}
+
 for _n in BENCH_STAMPED:
     assert METRICS.get(_n, (None,))[0] == COUNTER, \
         f"BENCH_STAMPED name {_n!r} is not a declared counter"
+for _n in BENCH_STAMPED_QUANTILES:
+    assert METRICS.get(_n, (None,))[0] == HISTOGRAM, \
+        f"BENCH_STAMPED_QUANTILES name {_n!r} is not a declared " \
+        f"histogram"
 del _n

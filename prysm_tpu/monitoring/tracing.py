@@ -3,31 +3,58 @@
 Reference analog: opencensus spans through every service hot path
 (``trace.StartSpan(ctx, "blockChain.onBlock")``) exported to Jaeger
 [U, SURVEY.md §5 "Tracing/profiling"].  Here: a contextvar span stack
-recording wall times (queryable in tests, dumpable as JSON), plus
-``jax.profiler`` trace-annotation integration for device timelines
-(the XProf/Perfetto analog of the reference's Jaeger export).
+recording wall times into a CAPPED ring buffer (queryable in tests,
+dumpable as JSON, renderable as Perfetto/chrome://tracing JSON via
+``tools/trace_report.py``), plus ``jax.profiler`` trace-annotation
+integration so the same spans land on the device timeline when an
+XProf profiler session is active (the Perfetto analog of the
+reference's Jaeger export).
+
+Span names are DECLARED in ``monitoring/registry.py`` (``SPANS``) and
+enforced both directions by the static-analysis gate — a typo'd span
+name fails ``make lint`` exactly like a typo'd metric name.
+
+Cost model: with tracing off, ``span(...)`` is one module-global
+branch returning a shared no-op context manager — no record, no
+timestamp, no allocation beyond the call itself.  The ring bounds
+memory under ``make soak`` (the old unbounded list grew forever);
+capacity comes from ``PRYSM_TPU_TRACE_RING`` (default 4096).
 """
 
 from __future__ import annotations
 
-import contextlib
 import contextvars
 import json
+import os
 import threading
 import time
+from collections import deque
+
+RING_ENV = "PRYSM_TPU_TRACE_RING"
+_DEFAULT_RING = 4096
 
 _stack: contextvars.ContextVar[tuple] = contextvars.ContextVar(
     "span_stack", default=())
 
-_records: list[dict] = []
+_records: deque = deque(
+    maxlen=max(1, int(os.environ.get(RING_ENV, _DEFAULT_RING))))
 _records_lock = threading.Lock()
 _enabled = False
 _jax_trace = False
+
+#: process-start anchor for time_to_first_verdict_seconds
+_PROCESS_START = time.monotonic()
+_first_verdict = False
+_first_verdict_lock = threading.Lock()
 
 
 def enable_tracing(on: bool = True) -> None:
     global _enabled
     _enabled = on
+
+
+def tracing_enabled() -> bool:
+    return _enabled
 
 
 def enable_jax_trace(on: bool = True) -> None:
@@ -37,12 +64,24 @@ def enable_jax_trace(on: bool = True) -> None:
     _jax_trace = on
 
 
+def ring_capacity() -> int:
+    return _records.maxlen or _DEFAULT_RING
+
+
+def set_ring_capacity(n: int) -> None:
+    """Re-cap the span ring (keeps the newest records that fit)."""
+    global _records
+    with _records_lock:
+        _records = deque(_records, maxlen=max(1, int(n)))
+
+
 def clear() -> None:
     with _records_lock:
         _records.clear()
 
 
 def records() -> list[dict]:
+    """The ring's current contents, oldest first."""
     with _records_lock:
         return list(_records)
 
@@ -51,33 +90,93 @@ def dump_json() -> str:
     return json.dumps(records())
 
 
-@contextlib.contextmanager
-def span(name: str, **attrs):
-    """with span("blockchain.on_block"): ... — nesting is recorded via
-    dotted paths like the reference's span hierarchy."""
-    if not _enabled:
-        yield
-        return
-    parent = _stack.get()
-    path = parent + (name,)
-    token = _stack.set(path)
-    ann = None
-    if _jax_trace:
-        try:
-            import jax.profiler
+class _NullSpan:
+    """Shared no-op span: what every span site costs when tracing is
+    off (one branch in :func:`span`, two no-op calls here)."""
 
-            ann = jax.profiler.TraceAnnotation(name)
-            ann.__enter__()
-        except Exception:
-            ann = None
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        if ann is not None:
-            ann.__exit__(None, None, None)
-        _stack.reset(token)
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: pushes its dotted path on the contextvar stack,
+    times the block, and appends a record to the ring on exit."""
+
+    __slots__ = ("_name", "_attrs", "_token", "_ann", "_t0")
+
+    def __init__(self, name: str, attrs: dict):
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self) -> "_Span":
+        path = _stack.get() + (self._name,)
+        self._token = _stack.set(path)
+        self._ann = None
+        if _jax_trace:
+            try:
+                import jax.profiler
+
+                self._ann = jax.profiler.TraceAnnotation(self._name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        path = _stack.get()
+        _stack.reset(self._token)
+        rec = {"span": ".".join(path), "seconds": dt,
+               "t0": self._t0, "thread": threading.get_ident(),
+               **self._attrs}
         with _records_lock:
-            _records.append({
-                "span": ".".join(path), "seconds": dt, **attrs})
+            _records.append(rec)
+        return False
+
+
+def span(name: str, **attrs):
+    """``with span("chain.receive_block", slot=3): ...`` — nesting is
+    recorded via dotted paths like the reference's span hierarchy.
+    Returns the shared no-op span when tracing is off."""
+    if not _enabled:
+        return NULL_SPAN
+    return _Span(name, attrs)
+
+
+# --- time to first verdict ---------------------------------------------------
+
+
+def mark_first_verdict() -> None:
+    """Stamp ``time_to_first_verdict_seconds`` (gauge, from process
+    start) the FIRST time any pipeline verdict materializes; later
+    calls are one module-global branch.  The AOT/zero-stall roadmap
+    item's before/after number."""
+    global _first_verdict
+    if _first_verdict:
+        return
+    with _first_verdict_lock:
+        if _first_verdict:
+            return
+        _first_verdict = True
+    from .metrics import metrics
+
+    metrics.set("time_to_first_verdict_seconds",
+                time.monotonic() - _PROCESS_START)
+
+
+def reset_first_verdict() -> None:
+    """Re-arm the first-verdict stamp (tests / restart simulation)."""
+    global _first_verdict
+    with _first_verdict_lock:
+        _first_verdict = False

@@ -39,6 +39,10 @@ def main(argv=None) -> int:
     p.add_argument("--chain-config-file", default=None,
                    help="YAML overrides for chain constants")
     p.add_argument("--enable-tracing", action="store_true")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="arm the flight recorder: breaker trips / "
+                        "fault injections / fail-closed abandons dump "
+                        "black-box JSON files into DIR")
     p.add_argument("--metrics", action="store_true",
                    help="print the /metrics exposition at the end")
     p.add_argument("--prometheus-port", type=int, default=None,
@@ -93,6 +97,10 @@ def main(argv=None) -> int:
         from ..monitoring.tracing import enable_tracing
 
         enable_tracing(True)
+    if args.flight_dir:
+        from ..monitoring.flight import arm
+
+        arm(args.flight_dir)
 
     from ..config import beacon_config
     from ..proto import build_types

@@ -120,6 +120,12 @@ class BeaconNode:
     def _on_slot(self, slot: int) -> None:
         """Per-slot housekeeping: aggregate the pool, verify the
         previous slot's accumulated batch in ONE dispatch, prune."""
+        from ..monitoring import tracing as _tracing
+
+        with _tracing.span("node.slot", slot=slot):
+            self._slot_duties(slot)
+
+    def _slot_duties(self, slot: int) -> None:
         cfg = beacon_config()
         self.metrics.set("current_slot", slot)
         # linger deadline for the streaming scheduler: a partial

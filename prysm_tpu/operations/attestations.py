@@ -24,6 +24,7 @@ from ..core.helpers import (
     compute_signing_root, get_beacon_committee, get_domain,
 )
 from ..crypto.bls import bls
+from ..monitoring import tracing as _tracing
 from ..proto import Attestation
 
 
@@ -74,7 +75,7 @@ class AttestationPool:
         if sum(att.aggregation_bits) != 1:
             raise AttestationPoolError(
                 "unaggregated attestation must have exactly one bit")
-        with self._lock:
+        with _tracing.span("pool.ingress"), self._lock:
             g = self._groups[_group_key(att)]
             if any(att.aggregation_bits == e.aggregation_bits
                    and att.data == e.data for e in g.unaggregated):
@@ -84,7 +85,7 @@ class AttestationPool:
     def save_aggregated(self, att: Attestation) -> None:
         if sum(att.aggregation_bits) < 1:
             raise AttestationPoolError("empty aggregation bits")
-        with self._lock:
+        with _tracing.span("pool.ingress"), self._lock:
             g = self._groups[_group_key(att)]
             # drop if already covered by an existing aggregate
             for e in g.aggregated:
@@ -224,7 +225,7 @@ class AttestationPool:
 
         cfg = beacon_config()
         rows, roots, sigs, descs, atts = [], [], [], [], []
-        with self._lock:
+        with _tracing.span("pool.build", slot=slot), self._lock:
             self.pubkey_table.sync(state.validators,
                                    changed=pop_registry_changes(state))
             for committee, att in self._slot_entries(state, slot):
@@ -397,36 +398,42 @@ class IndexedSlotBatch:
         from ..crypto.bls.xla.compress import parse_g2_compressed
         from ..crypto.bls.xla.h2c import hash_to_field_host
         from ..crypto.bls.xla.verify import random_rlc_bits
+        from ..monitoring.metrics import metrics as _m
         from ..runtime import faults as _faults
 
-        _faults.fire("h2c_pack")
-        a = len(self.roots)
-        ab = _bucket(a)
-        inf_sig = bytes([0xC0]) + b"\x00" * 95
-        raw = np.frombuffer(
-            b"".join(list(self.sig_bytes) + [inf_sig] * (ab - a)),
-            dtype=np.uint8).reshape(ab, 96)
-        # sub-dispatch seam: per-limb corruption of the packed device
-        # buffers (DMA/HBM bitflip).  Fired on the signature buffer —
-        # the fail-closed graph turns a flipped limb into a CLEAN
-        # False, and any re-pack (retry, bisection) heals it because
-        # packing restarts from the host-side bytes.
-        raw = np.asarray(_faults.fire("device_buffer", raw),
-                         dtype=np.uint8)
-        sig_x, sig_i, sig_s, sig_wf = parse_g2_compressed(raw)
-        u0, u1 = hash_to_field_host(
-            list(self.roots) + [b""] * (ab - a), ETH2_DST)
-        idx = np.zeros((ab, self.idx.shape[1]), dtype=np.int32)
-        mask = np.zeros((ab, self.mask.shape[1]), dtype=bool)
-        idx[:a] = self.idx
-        mask[:a] = self.mask
-        r_bits = random_rlc_bits(ab, rng)
-        att_mask = jnp.arange(ab) < a
-        px, py, pinf = self.table.arrays()
-        return (px, py, pinf, jnp.asarray(idx), jnp.asarray(mask),
-                jnp.asarray(sig_x), jnp.asarray(sig_i),
-                jnp.asarray(sig_s), jnp.asarray(sig_wf), u0, u1,
-                r_bits, att_mask)
+        t0 = time.perf_counter()
+        with _tracing.span("dispatch.pack", entries=len(self)):
+            _faults.fire("h2c_pack")
+            a = len(self.roots)
+            ab = _bucket(a)
+            inf_sig = bytes([0xC0]) + b"\x00" * 95
+            raw = np.frombuffer(
+                b"".join(list(self.sig_bytes) + [inf_sig] * (ab - a)),
+                dtype=np.uint8).reshape(ab, 96)
+            # sub-dispatch seam: per-limb corruption of the packed
+            # device buffers (DMA/HBM bitflip).  Fired on the
+            # signature buffer — the fail-closed graph turns a flipped
+            # limb into a CLEAN False, and any re-pack (retry,
+            # bisection) heals it because packing restarts from the
+            # host-side bytes.
+            raw = np.asarray(_faults.fire("device_buffer", raw),
+                             dtype=np.uint8)
+            sig_x, sig_i, sig_s, sig_wf = parse_g2_compressed(raw)
+            u0, u1 = hash_to_field_host(
+                list(self.roots) + [b""] * (ab - a), ETH2_DST)
+            idx = np.zeros((ab, self.idx.shape[1]), dtype=np.int32)
+            mask = np.zeros((ab, self.mask.shape[1]), dtype=bool)
+            idx[:a] = self.idx
+            mask[:a] = self.mask
+            r_bits = random_rlc_bits(ab, rng)
+            att_mask = jnp.arange(ab) < a
+            px, py, pinf = self.table.arrays()
+            args = (px, py, pinf, jnp.asarray(idx), jnp.asarray(mask),
+                    jnp.asarray(sig_x), jnp.asarray(sig_i),
+                    jnp.asarray(sig_s), jnp.asarray(sig_wf), u0, u1,
+                    r_bits, att_mask)
+        _m.observe("stage_host_pack_seconds", time.perf_counter() - t0)
+        return args
 
     def verify_async(self, rng=None):
         """Dispatch the fused verify WITHOUT reading the verdict back;
@@ -440,16 +447,18 @@ class IndexedSlotBatch:
 
         if len(self) == 0:
             return True
-        _faults.fire("device_dispatch")
-        # the shared ladder runs one pair per live attestation plus
-        # the (-g1, [r]sig-sum) lane
-        _m.inc("pairing_ladder_pairs", len(self) + 1)
-        args = self.device_args(rng)
-        # host-transfer sanitizer (analysis/transfer.py): armed under
-        # PRYSM_TPU_SANITIZE, the fused dispatch itself must not move
-        # bytes between host and device — everything was staged above
-        with dispatch_guard():
-            return fused_slot_verify_device(*args)
+        with _tracing.span("dispatch.device", entries=len(self)):
+            _faults.fire("device_dispatch")
+            # the shared ladder runs one pair per live attestation
+            # plus the (-g1, [r]sig-sum) lane
+            _m.inc("pairing_ladder_pairs", len(self) + 1)
+            args = self.device_args(rng)
+            # host-transfer sanitizer (analysis/transfer.py): armed
+            # under PRYSM_TPU_SANITIZE, the fused dispatch itself must
+            # not move bytes between host and device — everything was
+            # staged above
+            with dispatch_guard():
+                return fused_slot_verify_device(*args)
 
     def verify(self, rng=None) -> bool:
         """ONE device dispatch: G2 decompression + subgroup checks +
@@ -483,10 +492,15 @@ class IndexedSlotBatch:
         if fused_breaker.allow():
             for attempt in (0, 1):
                 try:
-                    v = _faults.fire(
-                        "partial_readback",
-                        _faults.fire("readback", self.verify_async(rng)))
-                    ok = bool(np.asarray(v))
+                    v = self.verify_async(rng)
+                    t0 = time.perf_counter()
+                    with _tracing.span("dispatch.readback"):
+                        v = _faults.fire(
+                            "partial_readback",
+                            _faults.fire("readback", v))
+                        ok = bool(np.asarray(v))
+                    _m.observe("stage_readback_seconds",
+                               time.perf_counter() - t0)
                 except Exception as e:   # noqa: BLE001 — classified
                     if not _faults.is_transient(e):
                         raise            # malformed input: fail loudly
@@ -497,9 +511,11 @@ class IndexedSlotBatch:
                     fused_breaker.record_failure()
                     break
                 fused_breaker.record_success()
+                _tracing.mark_first_verdict()
                 return ok
         _m.inc("degraded_dispatches")
         self.fallback_verdicts = self.verify_each_pure()
+        _tracing.mark_first_verdict()
         return all(self.fallback_verdicts)
 
     def subset(self, entries) -> "IndexedSlotBatch":

@@ -106,6 +106,19 @@ class BeaconHTTPServer:
         elif path == "/metrics":
             h._send(200, self.node.metrics.render(),
                     content_type="text/plain")
+        elif path == "/debug/timeline":
+            # the span ring as JSON — the live view of what
+            # tools/trace_report.py renders as a Perfetto trace
+            from ..monitoring import tracing as _tracing
+
+            h._send(200, {"enabled": _tracing.tracing_enabled(),
+                          "records": _tracing.records()})
+        elif path == "/debug/flight":
+            # the flight recorder's black-box payload on demand
+            # (works disarmed: spans/metrics still carry state)
+            from ..monitoring import flight as _flight
+
+            h._send(200, _flight.snapshot())
         elif path == "/eth/v1/validator/attestation_data":
             data = self.api.get_attestation_data(
                 int(params["slot"]), int(params["committee_index"]))

@@ -182,10 +182,16 @@ class FaultSchedule:
             self._calls[point] = k + 1
         if not self._decide(point, k, spec):
             return payload
+        from ..monitoring import flight as _flight
         from ..monitoring.metrics import metrics as _m
 
         _m.inc("fault_injected_total")
         _m.inc(f"fault_injected_{point}")
+        _flight.note("fault_injected", point=point, call=k,
+                     mode=spec.mode)
+        # rate-limited (a fault STORM must not become a disk storm);
+        # breaker trips / abandons force their own dumps
+        _flight.dump("fault_injection")
         if spec.mode == "delay":
             time.sleep(spec.ms / 1000.0)
             return payload
@@ -387,12 +393,19 @@ class CircuitBreaker:
     def record_failure(self) -> None:
         with self._lock:
             self._consecutive += 1
-            if not self._open and self._consecutive >= self.trip_after:
+            tripped = (not self._open
+                       and self._consecutive >= self.trip_after)
+            if tripped:
                 self._open = True
                 self._denied = 0
                 m = self._metrics()
                 m.inc("breaker_trips")
                 m.set("breaker_open", 1)
+        if tripped:
+            from ..monitoring import flight as _flight
+
+            _flight.note("breaker_trip", name=self.name)
+            _flight.dump("breaker_trip", force=True)
 
     def is_open(self) -> bool:
         with self._lock:

@@ -52,6 +52,7 @@ from types import SimpleNamespace
 
 import numpy as np
 
+from ..monitoring import tracing as _tracing
 from . import faults as _faults
 
 
@@ -123,13 +124,21 @@ def _synthetic_verify_async(self, rng=None):
     and a re-pack (retry/bisection) heals it."""
     if len(self) == 0:
         return True
-    _faults.fire("h2c_pack")
-    raw = np.frombuffer(b"".join(bytes(s) for s in self.sig_bytes),
-                        dtype=np.uint8).reshape(len(self), SIG_LEN)
-    raw = np.asarray(_faults.fire("device_buffer", raw), dtype=np.uint8)
-    _faults.fire("device_dispatch")
-    ok = all(_entry_ok(self, i, raw[i].tobytes())
-             for i in range(len(self)))
+    from ..monitoring.metrics import metrics as _m
+
+    t0 = time.perf_counter()
+    with _tracing.span("dispatch.pack", entries=len(self)):
+        _faults.fire("h2c_pack")
+        raw = np.frombuffer(b"".join(bytes(s) for s in self.sig_bytes),
+                            dtype=np.uint8).reshape(len(self), SIG_LEN)
+        raw = np.asarray(_faults.fire("device_buffer", raw),
+                         dtype=np.uint8)
+    _m.observe("stage_host_pack_seconds", time.perf_counter() - t0)
+    with _tracing.span("dispatch.device", entries=len(self),
+                       synthetic=True):
+        _faults.fire("device_dispatch")
+        ok = all(_entry_ok(self, i, raw[i].tobytes())
+                 for i in range(len(self)))
     return np.asarray(ok)
 
 

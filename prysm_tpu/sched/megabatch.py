@@ -94,14 +94,14 @@ class MegabatchAccumulator:
         assert max_slots >= 1
         self.max_slots = int(max_slots)
         self.linger_s = float(linger_s)
-        self._pending: list = []     # [(handle, batch), ...]
+        self._pending: list = []     # [(handle, batch, enq_t), ...]
         self._oldest: float | None = None
 
     def __len__(self) -> int:
         return len(self._pending)
 
     def pending_handles(self) -> list:
-        return [h for h, _b in self._pending]
+        return [h for h, _b, _t in self._pending]
 
     def add(self, handle: int, batch, max_slots: int | None = None
             ) -> list:
@@ -121,7 +121,7 @@ class MegabatchAccumulator:
                 out.append(mb)
         if self._oldest is None:
             self._oldest = time.monotonic()
-        self._pending.append((handle, batch))
+        self._pending.append((handle, batch, time.monotonic()))
         if len(self._pending) >= limit:
             mb = self.flush(FLUSH_FULL)
             if mb is not None:
@@ -140,11 +140,17 @@ class MegabatchAccumulator:
         counter and the occupancy histogram."""
         if not self._pending:
             return None
+        now = time.monotonic()
         entries, self._pending = self._pending, []
-        self._oldest = None
-        joined = join_batches([b for _h, b in entries])
+        oldest, self._oldest = self._oldest, None
+        joined = join_batches([b for _h, b, _t in entries])
         m = _metrics()
         m.inc(f"megabatch_flushes_{reason}")
         m.observe("megabatch_occupancy", float(len(entries)))
         m.inc("megabatch_slots_dispatched", len(entries))
-        return Megabatch(entries=entries, joined=joined, reason=reason)
+        if oldest is not None:
+            m.observe("megabatch_linger_seconds", now - oldest)
+        for _h, _b, t_enq in entries:
+            m.observe("stage_queue_wait_seconds", now - t_enq)
+        return Megabatch(entries=[(h, b) for h, b, _t in entries],
+                         joined=joined, reason=reason)

@@ -45,6 +45,7 @@ import threading
 import time
 from collections import deque
 
+from ..monitoring import tracing as _tracing
 from ..runtime import faults as _faults
 from .megabatch import (
     FLUSH_CLOSE, FLUSH_DEMAND, FLUSH_LINGER, MegabatchAccumulator,
@@ -105,7 +106,7 @@ class StreamScheduler:
         """Queue one slot's ``IndexedSlotBatch``; returns the handle to
         pass to ``result``.  An empty batch verifies trivially True.
         May dispatch (occupancy/table-switch flush) before returning."""
-        with self._lock:
+        with self._lock, _tracing.span("sched.submit"):
             if self._closed:
                 raise RuntimeError("scheduler is closed")
             handle = self._next_handle
@@ -136,18 +137,21 @@ class StreamScheduler:
             self._dispatch(mb)
 
     def _dispatch(self, mb) -> None:
-        if _breaker().is_open():
-            # demoted: the breaker's allow/probe cycle inside each
-            # slot's own ladder governs recovery — never aim a fused
-            # megabatch at a device the breaker already declared dead
-            _metrics().inc("megabatch_demotions")
-            self._settle_by_slot(mb)
-            return
-        _metrics().inc("megabatch_dispatches")
-        joined = mb.joined
-        rng = self._rng
-        ticket = self._disp.submit(lambda: joined.verify_async(rng))
-        self._inflight.append((ticket, mb))
+        with _tracing.span("sched.flush", slots=len(mb),
+                           reason=mb.reason):
+            if _breaker().is_open():
+                # demoted: the breaker's allow/probe cycle inside each
+                # slot's own ladder governs recovery — never aim a
+                # fused megabatch at a device the breaker already
+                # declared dead
+                _metrics().inc("megabatch_demotions")
+                self._settle_by_slot(mb)
+                return
+            _metrics().inc("megabatch_dispatches")
+            joined = mb.joined
+            rng = self._rng
+            ticket = self._disp.submit(lambda: joined.verify_async(rng))
+            self._inflight.append((ticket, mb))
 
     # --- consumer side ------------------------------------------------------
 
@@ -210,26 +214,31 @@ class StreamScheduler:
                 # re-raises; innocent slots still get real verdicts
                 self._settle_by_slot(mb, bisected=True)
             self._observe_amortized(mb)
+            _tracing.mark_first_verdict()
             return
-        if ok:
-            _breaker().record_success()
-            for h, _b in mb.entries:
-                self._verdicts[h] = True
-        elif len(mb.joined) == 1:
-            # a clean single-attestation False is already fully
-            # isolated — a VERDICT, not a fault: the consumer's own
-            # per-attestation recovery takes over (identical to the
-            # fused per-slot path's semantics)
-            _breaker().record_success()
-            self._verdicts[mb.entries[0][0]] = False
-        else:
-            # the RLC check rejected the megabatch cleanly: some
-            # attestation aboard is poisoned — bisect ON-DEVICE to
-            # isolate the bad entries instead of collapsing to the
-            # per-signature pure fallback
-            _breaker().record_success()
-            self._bisect_megabatch(mb)
+        t_dx = time.perf_counter()
+        with _tracing.span("sched.demux", slots=len(mb)):
+            if ok:
+                _breaker().record_success()
+                for h, _b in mb.entries:
+                    self._verdicts[h] = True
+            elif len(mb.joined) == 1:
+                # a clean single-attestation False is already fully
+                # isolated — a VERDICT, not a fault: the consumer's
+                # own per-attestation recovery takes over (identical
+                # to the fused per-slot path's semantics)
+                _breaker().record_success()
+                self._verdicts[mb.entries[0][0]] = False
+            else:
+                # the RLC check rejected the megabatch cleanly: some
+                # attestation aboard is poisoned — bisect ON-DEVICE to
+                # isolate the bad entries instead of collapsing to the
+                # per-signature pure fallback
+                _breaker().record_success()
+                self._bisect_megabatch(mb)
+        m.observe("stage_demux_seconds", time.perf_counter() - t_dx)
         self._observe_amortized(mb)
+        _tracing.mark_first_verdict()
 
     def _bisect_megabatch(self, mb) -> None:
         """The on-device bisection rung: re-verify halves of the
@@ -242,7 +251,8 @@ class StreamScheduler:
         to the per-slot PR-2 ladders."""
         _metrics().inc("megabatch_bisects")
         try:
-            entry_verdicts = mb.joined.bisect_verify(self._rng)
+            with _tracing.span("sched.bisect"):
+                entry_verdicts = mb.joined.bisect_verify(self._rng)
         except Exception as e:   # noqa: BLE001 — classified below
             if _faults.is_transient(e):
                 _breaker().record_failure()
@@ -296,6 +306,11 @@ class StreamScheduler:
                 for h, _b in mb.entries:
                     self._verdicts[h] = False
                 m.inc("fail_closed_abandons", len(mb.entries))
+                from ..monitoring import flight as _flight
+
+                _flight.note("scheduler_close_abandon",
+                             slots=len(mb.entries))
+                _flight.dump("fail_closed_abandon")
             inflight_slots = 0
             for _ticket, inflight_mb in self._inflight:
                 for h, _b in inflight_mb.entries:

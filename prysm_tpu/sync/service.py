@@ -317,6 +317,12 @@ class SyncService:
         slot's honest votes (reference behavior: per-message gossip
         verification; here the batch is the fast path and the split
         is the recovery path)."""
+        from ..monitoring import tracing as _tracing
+
+        with _tracing.span("sync.slot_batch", slot=slot):
+            return self._verify_slot_batch(slot)
+
+    def _verify_slot_batch(self, slot: int) -> bool:
         from ..core.helpers import is_valid_indexed_attestation
         from ..core.helpers import get_indexed_attestation
 

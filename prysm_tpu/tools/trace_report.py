@@ -1,0 +1,163 @@
+"""Render tracing span records as Perfetto / chrome://tracing JSON.
+
+Two modes:
+
+* convert: ``python -m prysm_tpu.tools.trace_report --in spans.json
+  --out trace.json`` turns a ``tracing.dump_json()`` record list into
+  a Trace Event Format file (load it at https://ui.perfetto.dev or
+  chrome://tracing).
+* traced soak: ``python -m prysm_tpu.tools.trace_report --soak 64
+  --out TRACE_SOAK.json --flight-dir .flight`` runs the chaos soak
+  harness with tracing on and the flight recorder armed, writes the
+  Perfetto trace, and prints a JSON summary: per-stage latency
+  quantiles, time-to-first-verdict, flight-recorder dump paths
+  (``make trace``).  ``--jax-profile DIR`` additionally opens a
+  jax.profiler session with TraceAnnotations on, so the SAME span
+  names land on the device timeline (XProf) and host spans can be
+  lined up against device compute.
+
+Each span record becomes one complete ("ph": "X") event: ``name`` is
+the dotted span path, ``ts``/``dur`` are microseconds from the first
+record, ``tid`` is the recording thread, and span attrs ride in
+``args``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: record keys that map onto trace-event fields (everything else is a
+#: span attr and rides in "args")
+_EVENT_KEYS = ("span", "seconds", "t0", "thread")
+
+
+def to_chrome_trace(records, pid: int = 1) -> dict:
+    """Trace Event Format dict for a list of tracing records."""
+    base = min((r["t0"] for r in records), default=0.0)
+    events = []
+    for r in records:
+        events.append({
+            "name": r["span"],
+            "cat": "host",
+            "ph": "X",
+            "ts": (r["t0"] - base) * 1e6,
+            "dur": r["seconds"] * 1e6,
+            "pid": pid,
+            "tid": r.get("thread", 0),
+            "args": {k: v for k, v in r.items()
+                     if k not in _EVENT_KEYS},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _quantiles(names) -> dict:
+    """{name: {n, p50, p90, p99}} for every non-empty histogram."""
+    from ..monitoring.metrics import metrics
+
+    out = {}
+    for name in names:
+        h = metrics.histogram(name)
+        if h.n:
+            out[name] = {"n": h.n,
+                         "p50": round(h.quantile(0.5), 6),
+                         "p90": round(h.quantile(0.9), 6),
+                         "p99": round(h.quantile(0.99), 6)}
+    return out
+
+
+def _run_traced_soak(n_slots: int, out: str, flight_dir: str,
+                     jax_profile: str | None, seed: int) -> dict:
+    import os
+
+    from ..config import set_features, use_minimal_config
+    from ..monitoring import flight, tracing
+    from ..monitoring.metrics import metrics
+    from ..monitoring.registry import BENCH_STAMPED_QUANTILES
+    from ..runtime import faults
+    from ..runtime.scenarios import run_soak
+
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    tracing.enable_tracing(True)
+    tracing.clear()
+    tracing.reset_first_verdict()
+    # a soak's fault storm fires many per-slot: keep the rate limit
+    # low enough to collect several dumps, high enough not to thrash
+    flight.arm(flight_dir, min_interval_s=0.25)
+    prof = False
+    if jax_profile:
+        import jax.profiler
+
+        tracing.enable_jax_trace(True)
+        jax.profiler.start_trace(jax_profile)
+        prof = True
+    try:
+        # empty schedule shields the run from any env chaos spec; the
+        # soak drives its own seeded device-fault storm window
+        with faults.inject():
+            report = run_soak(n_slots=n_slots, seed=seed)
+    finally:
+        if prof:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+    records = tracing.records()
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(to_chrome_trace(records), f)
+    ttfv = metrics.gauge("time_to_first_verdict_seconds").value
+    dumps = sorted(
+        os.path.join(flight_dir, fn)
+        for fn in os.listdir(flight_dir)
+        if fn.startswith("flight-") and fn.endswith(".json"))
+    return {
+        "trace": out,
+        "spans_recorded": len(records),
+        "stage_quantiles_s": _quantiles(BENCH_STAMPED_QUANTILES),
+        "time_to_first_verdict_s": round(ttfv, 6),
+        "flight_dumps": dumps,
+        "jax_profile": jax_profile,
+        "soak": {k: report[k] for k in
+                 ("slots", "elapsed_s", "slots_per_sec",
+                  "divergences", "fail_closed_abandons")},
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="prysm_tpu.tools.trace_report",
+        description="Span records -> Perfetto/chrome://tracing JSON")
+    p.add_argument("--in", dest="infile", default=None, metavar="FILE",
+                   help="convert a tracing.dump_json() record list")
+    p.add_argument("--out", default="trace.json", metavar="FILE",
+                   help="Perfetto JSON output path")
+    p.add_argument("--soak", type=int, default=None, metavar="N",
+                   help="run an N-slot traced soak with the flight "
+                        "recorder armed, then render + summarize")
+    p.add_argument("--flight-dir", default=".flight", metavar="DIR",
+                   help="flight-recorder dump directory (soak mode)")
+    p.add_argument("--seed", type=int, default=1337)
+    p.add_argument("--jax-profile", default=None, metavar="DIR",
+                   help="also capture a jax.profiler trace with span "
+                        "TraceAnnotations into DIR (soak mode)")
+    args = p.parse_args(argv)
+
+    if args.soak is not None:
+        summary = _run_traced_soak(args.soak, args.out,
+                                   args.flight_dir, args.jax_profile,
+                                   args.seed)
+        print(json.dumps(summary, indent=2))
+        return 0
+    if args.infile is None:
+        p.error("one of --in or --soak is required")
+    with open(args.infile, "r", encoding="utf-8") as f:
+        records = json.load(f)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(to_chrome_trace(records), f)
+    print(f"{args.out}: {len(records)} spans")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

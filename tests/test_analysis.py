@@ -21,8 +21,8 @@ import pytest
 from prysm_tpu.analysis import astlint
 from prysm_tpu.analysis.astlint import (
     DeadImportChecker, FaultSeamChecker, JitHazardChecker,
-    MetricsRegistryChecker, RecompileHazardChecker, run_checkers,
-    run_tree,
+    MetricsRegistryChecker, RecompileHazardChecker,
+    SpanRegistryChecker, run_checkers, run_tree,
 )
 from prysm_tpu.config import (
     set_features, use_mainnet_config, use_minimal_config,
@@ -139,6 +139,38 @@ class TestMetricsRegistryFixture:
                    and "never used" in f.message for f in fs)
 
 
+# --- span-registry checker ---------------------------------------------------
+
+_FAKE_SPANS = {
+    "chain.receive_block": "test",
+    "pool.ingress": "test",
+    "sched.never_opened": "test",
+}
+
+
+class TestSpanRegistryFixture:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return run_checkers(
+            [SpanRegistryChecker(declared=dict(_FAKE_SPANS))],
+            files=_fixture("bad_spans.py"))
+
+    def test_typo_span_flagged(self, findings):
+        assert any("chain.receive_blonk" in f.message
+                   and "not declared" in f.message for f in findings)
+
+    def test_dead_declaration_flagged(self, findings):
+        # declared but never opened in the fixture tree
+        assert any("chain.receive_block" in f.message
+                   and "dead span" in f.message for f in findings)
+        assert any("sched.never_opened" in f.message
+                   and "dead span" in f.message for f in findings)
+
+    def test_correct_use_not_flagged(self, findings):
+        assert not any("'pool.ingress'" in f.message for f in findings)
+        assert len(findings) == 3
+
+
 # --- fault-seam checker ------------------------------------------------------
 
 
@@ -213,6 +245,19 @@ class TestCleanTree:
         for p in _POINTS:
             assert METRICS[f"fault_injected_{p}"][0] == COUNTER
         assert set(BENCH_STAMPED) <= set(METRICS)
+
+    def test_stage_quantiles_and_spans_declared(self):
+        from prysm_tpu.monitoring.registry import (
+            BENCH_STAMPED_QUANTILES, HISTOGRAM, METRICS, SPANS,
+        )
+
+        for n in BENCH_STAMPED_QUANTILES:
+            assert METRICS[n][0] == HISTOGRAM
+        # the 5 lifecycle seams of the tentpole are all declared
+        for stage in ("queue_wait", "host_pack", "device_compute",
+                      "readback", "demux"):
+            assert f"stage_{stage}_seconds" in METRICS
+        assert len(SPANS) >= 10
 
 
 # --- transfer-guard sanitizer ------------------------------------------------

@@ -1,0 +1,262 @@
+"""Slot-lifecycle observability suite (ISSUE 11).
+
+Covers the tracing layer (ring buffer, nested contextvar paths across
+threads, the one-branch zero-overhead-off contract), the flight
+recorder (forced dump on a breaker trip, rate-limited dump on fault
+injection, disarmed no-op), the five stage-latency histograms + the
+time-to-first-verdict gauge populated by a short QUIET synthetic soak
+(no storm window, no poisoning — fast and deterministic), and the
+Perfetto / chrome://tracing JSON shape from tools/trace_report.py.
+
+Everything here runs under synthetic crypto — no fused-graph
+compiles, so the file stays cheap despite sorting after test_soak.
+"""
+
+import json
+import threading
+
+import pytest
+
+from prysm_tpu.config import (
+    set_features, use_mainnet_config, use_minimal_config,
+)
+from prysm_tpu.monitoring import flight, tracing
+from prysm_tpu.monitoring.metrics import metrics
+from prysm_tpu.runtime import faults
+from prysm_tpu.tools.trace_report import to_chrome_trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    """Every test starts traced-off with an empty ring and a disarmed
+    flight recorder, and leaves the process the same way."""
+    tracing.enable_tracing(False)
+    tracing.clear()
+    tracing.reset_first_verdict()
+    flight.disarm()
+    yield
+    tracing.enable_tracing(False)
+    tracing.clear()
+    tracing.reset_first_verdict()
+    flight.disarm()
+
+
+# --- ring buffer -------------------------------------------------------------
+
+
+class TestRingBuffer:
+    def test_ring_caps_and_keeps_newest(self):
+        old = tracing.ring_capacity()
+        tracing.set_ring_capacity(8)
+        try:
+            tracing.enable_tracing(True)
+            for i in range(50):
+                with tracing.span("outer", i=i):
+                    pass
+            recs = tracing.records()
+            assert len(recs) == 8
+            assert [r["i"] for r in recs] == list(range(42, 50))
+        finally:
+            tracing.set_ring_capacity(old)
+
+    def test_dump_json_round_trips(self):
+        tracing.enable_tracing(True)
+        with tracing.span("outer", slot=3):
+            pass
+        recs = json.loads(tracing.dump_json())
+        assert recs == tracing.records()
+        assert recs[-1]["span"] == "outer"
+        assert recs[-1]["slot"] == 3
+
+
+# --- nested spans across threads ---------------------------------------------
+
+
+class TestNestedThreads:
+    def test_paths_nest_per_thread(self):
+        tracing.enable_tracing(True)
+
+        def work(tag):
+            with tracing.span("outer", tag=tag):
+                with tracing.span("inner"):
+                    pass
+
+        ts = [threading.Thread(target=work, args=(t,))
+              for t in ("a", "b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        paths = [r["span"] for r in tracing.records()]
+        # the contextvar stack is thread-local: each thread records
+        # outer.inner then outer, never cross-thread contamination
+        assert sorted(paths) == ["outer", "outer", "outer.inner",
+                                 "outer.inner"]
+        by_thread = {}
+        for r in tracing.records():
+            by_thread.setdefault(r["thread"], []).append(r["span"])
+        assert len(by_thread) == 2
+        for spans in by_thread.values():
+            assert spans == ["outer.inner", "outer"]
+
+
+# --- zero overhead when off --------------------------------------------------
+
+
+class TestZeroOverheadOff:
+    def test_off_returns_null_singleton(self):
+        assert not tracing.tracing_enabled()
+        s = tracing.span("outer")
+        assert s is tracing.span("inner", slot=1)
+        assert s is tracing.NULL_SPAN
+        with s:
+            pass
+        assert tracing.records() == []
+
+    def test_first_verdict_gauge_marks_once(self):
+        tracing.mark_first_verdict()
+        v = metrics.gauge("time_to_first_verdict_seconds").value
+        assert v > 0
+        metrics.set("time_to_first_verdict_seconds", 123.0)
+        tracing.mark_first_verdict()   # already marked: no overwrite
+        assert metrics.gauge(
+            "time_to_first_verdict_seconds").value == 123.0
+
+
+# --- flight recorder ---------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_breaker_trip_forces_dump(self, tmp_path):
+        flight.arm(str(tmp_path), min_interval_s=3600.0)
+        br = faults.CircuitBreaker(trip_after=1, probe_every=8,
+                                   name="flight-test")
+        br.record_failure()            # trips -> force-dumped black box
+        dumps = sorted(tmp_path.glob("flight-*.json"))
+        assert len(dumps) == 1
+        payload = json.loads(dumps[0].read_text())
+        assert payload["trigger"] == "breaker_trip"
+        assert any(e["kind"] == "breaker_trip"
+                   and e["name"] == "flight-test"
+                   for e in payload["events"])
+        for key in ("spans", "metrics", "counter_deltas"):
+            assert key in payload
+
+    def test_fault_injection_dump_rate_limited(self, tmp_path):
+        flight.arm(str(tmp_path), min_interval_s=0.0)
+        with faults.inject(seed=7, readback={"rate": 1.0}):
+            with pytest.raises(faults.FaultError):
+                faults.fire("readback", object())
+        assert any(e["kind"] == "fault_injected"
+                   and e["point"] == "readback"
+                   for e in flight.snapshot()["events"])
+        assert list(tmp_path.glob("flight-*.json"))
+        # re-arm with a huge min interval: dump() without force obeys it
+        flight.arm(str(tmp_path), min_interval_s=3600.0)
+        flight.dump("first")
+        n = len(list(tmp_path.glob("flight-*.json")))
+        assert flight.dump("rate_limited") is None
+        assert len(list(tmp_path.glob("flight-*.json"))) == n
+
+    def test_disarmed_is_noop(self, tmp_path):
+        assert not flight.armed()
+        flight.note("ignored_event", x=1)
+        assert flight.dump("anything", force=True) is None
+        assert list(tmp_path.glob("flight-*.json")) == []
+        # snapshot still works disarmed (the /debug/flight endpoint)
+        snap = flight.snapshot()
+        assert snap["armed"] is False
+        assert snap["events"] == []
+
+
+# --- stage histograms via a quiet soak ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def quiet_soak_report():
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    tracing.enable_tracing(True)
+    tracing.clear()
+    tracing.reset_first_verdict()
+    try:
+        with faults.inject():          # shield from env chaos specs
+            report = run_soak_quiet()
+        yield report, tracing.records()
+    finally:
+        tracing.enable_tracing(False)
+        tracing.clear()
+        set_features(bls_implementation="pure")
+        use_mainnet_config()
+
+
+def run_soak_quiet():
+    from prysm_tpu.runtime.scenarios import run_soak
+
+    return run_soak(n_slots=12, seed=42, poison_rate=0.0,
+                    reorg_every=0, slashing_every=0, churn_every=0,
+                    storm_start=-1, real_registry=False)
+
+
+class TestStageHistograms:
+    STAGES = ("stage_queue_wait_seconds", "stage_host_pack_seconds",
+              "stage_device_compute_seconds", "stage_readback_seconds",
+              "stage_demux_seconds")
+
+    def test_all_five_seams_populate(self, quiet_soak_report):
+        _report, _recs = quiet_soak_report
+        for name in self.STAGES:
+            assert metrics.histogram(name).n > 0, name
+
+    def test_linger_and_ttfv(self, quiet_soak_report):
+        report, _recs = quiet_soak_report
+        assert report["divergences"] == []
+        assert metrics.histogram("megabatch_linger_seconds").n > 0
+        assert metrics.gauge(
+            "time_to_first_verdict_seconds").value > 0
+
+    def test_lifecycle_spans_recorded(self, quiet_soak_report):
+        _report, recs = quiet_soak_report
+        names = {r["span"] for r in recs}
+        leaves = {n.split(".")[-1] for n in names}
+        # nested dotted paths end in the seam leaves regardless of
+        # what they nested under
+        for leaf in ("submit", "flush", "demux", "pack"):
+            assert leaf in leaves, (leaf, sorted(names))
+
+    def test_quantiles_exposed(self, quiet_soak_report):
+        h = metrics.histogram("stage_queue_wait_seconds")
+        assert 0 <= h.quantile(0.5) <= h.quantile(0.99)
+        snap = metrics.snapshot()["stage_queue_wait_seconds"]
+        assert snap["kind"] == "histogram"
+        assert snap["n"] == h.n
+
+
+# --- chrome trace shape ------------------------------------------------------
+
+
+class TestTraceReport:
+    def test_chrome_trace_shape(self):
+        recs = [
+            {"span": "outer", "seconds": 0.25, "t0": 100.0,
+             "thread": 1, "slot": 7},
+            {"span": "outer.inner", "seconds": 0.1, "t0": 100.05,
+             "thread": 1},
+        ]
+        doc = to_chrome_trace(recs)
+        assert doc["displayTimeUnit"] == "ms"
+        evs = doc["traceEvents"]
+        assert [e["ph"] for e in evs] == ["X", "X"]
+        assert evs[0]["name"] == "outer"
+        assert evs[0]["ts"] == 0.0            # normalized to first t0
+        assert evs[0]["dur"] == pytest.approx(0.25e6)
+        assert evs[1]["ts"] == pytest.approx(0.05e6)
+        assert evs[0]["args"] == {"slot": 7}  # attrs ride in args
+        assert evs[1]["tid"] == 1
+
+    def test_live_records_convert(self, quiet_soak_report):
+        _report, recs = quiet_soak_report
+        doc = to_chrome_trace(recs)
+        assert len(doc["traceEvents"]) == len(recs)
+        # every event json-serializes (Perfetto-loadable)
+        json.dumps(doc)
