@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow lint chaos stream soak trace warm-cache dryrun bench native proto race
+.PHONY: test test-slow lint chaos stream soak overload trace warm-cache dryrun bench native proto race
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -55,6 +55,15 @@ stream:
 soak:
 	$(PY) -m pytest tests/test_soak.py -q -m "soak or not soak" -x
 	PRYSM_TIER_BUDGET=900 $(PY) bench.py --tier soak
+
+# Overload gate (ISSUE 12): a seeded ingress storm at ~4x the claim
+# budget against the admission controller, deadline shedding, and the
+# depth auto-tuner — the ledger must balance (rejections + sheds +
+# verdicts == submissions), admitted-work p99 stays bounded, zero
+# divergence, zero fail-closed abandons.
+overload:
+	$(PY) -m pytest tests/test_overload.py -q -m "soak or not soak" -x
+	PRYSM_TIER_BUDGET=900 $(PY) bench.py --tier overload
 
 # Observability artifact (ISSUE 11): a short traced soak with the
 # flight recorder armed — writes TRACE_SOAK.json (load at

@@ -242,7 +242,7 @@ def _epoch_replay_at(n_validators: int):
     from prysm_tpu.config import MAINNET_CONFIG
     from prysm_tpu.crypto.bls import bls as _bls
     from prysm_tpu.proto import build_types
-    from prysm_tpu.sched import StreamScheduler
+    from prysm_tpu.sched import DepthAutoTuner, StreamScheduler
     from prysm_tpu.testing.util import (
         deterministic_genesis_state, generate_full_block,
     )
@@ -275,7 +275,11 @@ def _epoch_replay_at(n_validators: int):
         """One streamed replay pass; returns blocks completed (the
         whole epoch unless the deadline cut it short)."""
         work = genesis.copy()
-        sched = StreamScheduler(max_slots=16, linger_s=30.0)
+        # depth auto-tuned 1 -> 16 off the observed backlog instead
+        # of a static N=16: the replay ramps into deep megabatch
+        # tickets as submissions outpace the drain
+        sched = StreamScheduler(max_slots=1, linger_s=30.0)
+        tuner = DepthAutoTuner(sched, max_depth=16)
         handles, done = [], 0
         for blk in blocks:
             if deadline is not None and _t.monotonic() >= deadline:
@@ -284,6 +288,7 @@ def _epoch_replay_at(n_validators: int):
                 process_slots(work, blk.message.slot, types)
             b = collect_block_signature_batch_indexed(work, blk, table)
             handles.append(sched.submit(b))
+            tuner.tick()
             state_transition(work, blk, types, verify_signatures=False)
             done += 1
         for h in handles:
@@ -587,6 +592,54 @@ def bench_soak():
     }
 
 
+def bench_overload():
+    """Overload tier: a seeded ingress storm at ~4x the claim budget
+    through the real streaming scheduler behind the admission
+    controller and depth auto-tuner — ``runtime/scenarios.run_overload``.
+    The metric of merit is the admitted-work p99 latency ratio
+    (loaded vs unloaded) under the explicit-outcome ledger: every
+    submission ends as a rejection, a deadline shed, or a verdict —
+    nothing vanishes, nothing is abandoned."""
+    from prysm_tpu.config import set_features, use_minimal_config
+
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    from prysm_tpu.runtime.scenarios import run_overload
+
+    tier_budget = float(os.environ.get("PRYSM_TIER_BUDGET", "0"))
+    deadline_s = tier_budget * 0.8 if tier_budget > 0 else None
+    report = run_overload(n_steps=600, seed=1337,
+                          deadline_budget_s=deadline_s)
+    assert report["accounting_ok"], report
+    assert report["shed_accounting_ok"], report
+    assert not report["divergences"], report["divergences"]
+    assert report["fail_closed_abandons"] == 0, report
+    assert report["rejections"] > 0, report
+    assert report["sheds"] > 0, report
+    assert report["depth"]["max_reached"] >= 8, report["depth"]
+    assert report["depth"]["final"] <= 2, report["depth"]
+    # bounded p99 for admitted work: within 2x the unloaded baseline
+    # (5 ms floor — synthetic verifies are sub-ms) or the shed
+    # deadline, whichever is larger — the deadline is the contract's
+    # hard upper bound on how stale admitted work can get
+    bound = max(2.0 * max(report["unloaded_p99_s"], 0.005),
+                report["deadline_s"])
+    assert report["loaded_p99_s"] <= bound, report
+    return {
+        "metric": "overload_latency_ratio",
+        "value": report["latency_ratio"],
+        "unit": (f"loaded/unloaded admitted-work p99 "
+                 f"({report['submissions']} submissions"
+                 f"{', PARTIAL' if report['partial'] else ''}: "
+                 f"{report['rejections']} rejected, "
+                 f"{report['sheds']} shed, "
+                 f"{report['verdicts']} verdicts; depth "
+                 f"1->{report['depth']['max_reached']}->"
+                 f"{report['depth']['final']})"),
+        "vs_baseline": 0.0,
+    }
+
+
 TIERS = [
     # (name, fn, wall budget seconds — generous for first compiles;
     # the persistent cache makes reruns fast)
@@ -602,6 +655,7 @@ TIERS = [
     ("htr_state_warm", bench_htr_state_warm, 900),
     ("field_throughput", bench_field_throughput, 300),
     ("soak", bench_soak, 900),
+    ("overload", bench_overload, 900),
 ]
 
 # the five BASELINE.json configs (plus companions) recorded every
@@ -610,7 +664,7 @@ TIERS = [
 FULL_TIERS = ("single_verify", "aggregate_verify", "slot_verify",
               "slot_throughput", "slot_pipeline", "stream_verify",
               "htr_registry", "htr_state_warm", "epoch_replay",
-              "epoch_replay_16k", "soak")
+              "epoch_replay_16k", "soak", "overload")
 
 
 # --- harness self-test hooks (tests/test_bench_harness.py) ------------------

@@ -42,6 +42,14 @@ _PENDING = object()
 _ABANDONED = object()
 
 
+class DeadlineRefused(Exception):
+    """Raised by ``submit(..., deadline=...)`` when the observed
+    device-compute p90 says the ticket cannot land before its
+    deadline.  Refusing up front is the cheap half of deadline
+    shedding: no ticket is allocated, no host packing runs, no device
+    time is burned on a verdict nobody can use."""
+
+
 class SlotDispatcher:
     def __init__(self, max_in_flight: int = 2):
         assert max_in_flight >= 1
@@ -58,11 +66,36 @@ class SlotDispatcher:
 
     # --- producer side -----------------------------------------------------
 
-    def submit(self, work) -> int:
+    def _deadline_estimate(self) -> float:
+        """Expected device-compute time for the next ticket: the
+        observed ``stage_device_compute_seconds`` p90 (0.0 while the
+        reservoir is empty — an unwarmed dispatcher refuses only
+        already-expired deadlines)."""
+        from ....monitoring.metrics import metrics as _m
+
+        return _m.histogram("stage_device_compute_seconds").quantile(0.9)
+
+    def submit(self, work, deadline: float | None = None) -> int:
         """Run ``work()`` (host packing + async device dispatch) and
         track its in-flight result.  Returns the ticket to pass to
         ``result``.  ``work`` must return the UN-read-back device
-        value (or any value; host values pass straight through)."""
+        value (or any value; host values pass straight through).
+        ``deadline`` (absolute ``time.monotonic()``) raises
+        :class:`DeadlineRefused` — before any ticket allocation or
+        host packing — when the device-compute p90 cannot meet it."""
+        if deadline is not None:
+            est = self._deadline_estimate()
+            if time.monotonic() + est >= deadline:
+                from ....monitoring import flight as _flight
+                from ....monitoring.metrics import metrics as _m
+
+                _m.inc("dispatch_deadline_refusals")
+                _flight.note("dispatch_deadline_refused",
+                             margin_s=round(deadline - time.monotonic(), 6),
+                             device_p90_s=round(est, 6))
+                raise DeadlineRefused(
+                    f"deadline margin {deadline - time.monotonic():.3f}s "
+                    f"< device-compute p90 {est:.3f}s")
         with self._lock:
             if self._closed:
                 raise RuntimeError("dispatcher is closed")

@@ -44,6 +44,23 @@ _events: deque = deque(
 _last_dump = 0.0          # monotonic; 0 == never
 _seq = 0
 _last_counters: dict[str, float] = {}
+# subsystem state providers: name -> zero-arg callable returning a
+# JSON-able dict, called (exception-guarded) at snapshot time — how
+# the admission controller and depth auto-tuner ride along in every
+# black box without the recorder importing them
+_providers: dict[str, object] = {}
+
+
+def register_provider(name: str, fn) -> None:
+    """Attach a live-state provider to every future snapshot/dump.
+    Re-registering a name replaces it (fresh controller per soak)."""
+    with _lock:
+        _providers[name] = fn
+
+
+def unregister_provider(name: str) -> None:
+    with _lock:
+        _providers.pop(name, None)
 
 
 def arm(directory: str, min_interval_s: float | None = None,
@@ -90,6 +107,13 @@ def snapshot(trigger: str = "snapshot") -> dict:
 
     with _lock:
         events = list(_events)
+        providers = dict(_providers)
+    state = {}
+    for name, fn in providers.items():
+        try:
+            state[name] = fn()
+        except Exception as e:   # noqa: BLE001 — a dead provider must
+            state[name] = {"error": repr(e)}   # not kill the black box
     metric_snap = metrics.snapshot()
     counters = {k: v["value"] for k, v in metric_snap.items()
                 if v["kind"] == "counter"}
@@ -102,6 +126,7 @@ def snapshot(trigger: str = "snapshot") -> dict:
         "unix_time": time.time(),
         "armed": _armed,
         "events": events,
+        "state": state,
         "spans": tracing.records()[-_SPAN_TAIL:],
         "metrics": metric_snap,
         "counter_deltas": deltas,

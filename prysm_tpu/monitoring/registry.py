@@ -119,6 +119,29 @@ _BASE: dict[str, tuple[str, str]] = {
     "time_to_first_verdict_seconds": (
         GAUGE, "process start -> first pipeline verdict (cold-start "
                "metric of record)"),
+    # --- overload control: admission / shedding / auto-tuner (PR 12)
+    "admission_admits": (
+        COUNTER, "submissions admitted past the ingress controller"),
+    "admission_rejections": (
+        COUNTER, "submissions refused at ingress with an explicit "
+                 "RETRY_AFTER hint (never a silent drop)"),
+    "admitted_verdict_latency_seconds": (
+        HISTOGRAM, "submit -> verdict latency of admitted, non-shed "
+                   "work (the overload SLO histogram)"),
+    "depth_autotune_depth": (
+        GAUGE, "current auto-tuned megabatch depth N"),
+    "depth_autotune_lower": (
+        COUNTER, "auto-tuner depth decreases (drain/linger or breaker "
+                 "demotion)"),
+    "depth_autotune_raise": (
+        COUNTER, "auto-tuner depth increases under backlog"),
+    "dispatch_deadline_refusals": (
+        COUNTER, "tickets refused up front: device-compute p90 cannot "
+                 "meet the deadline"),
+    "shed_deadline_exceeded": (
+        COUNTER, "slots shed fail-closed because their deadline passed "
+                 "before device dispatch (distinct from "
+                 "fail_closed_abandons: late, not lost)"),
     # --- node / services
     "block_processing_seconds": (
         HISTOGRAM, "per-block processing latency (blockchain service)"),
@@ -169,6 +192,9 @@ BENCH_STAMPED: tuple[str, ...] = (
     "registry_churn_events", "soak_slots",
     "pairing_ladder_pairs", "pallas_tower_dispatches",
     "tower_backend_selections",
+    "admission_admits", "admission_rejections",
+    "shed_deadline_exceeded", "dispatch_deadline_refusals",
+    "depth_autotune_raise", "depth_autotune_lower",
 )
 
 #: histograms bench.py stamps into each tier's JSON as p50/p90/p99
@@ -179,6 +205,7 @@ BENCH_STAMPED_QUANTILES: tuple[str, ...] = (
     "stage_device_compute_seconds", "stage_readback_seconds",
     "stage_demux_seconds", "megabatch_linger_seconds",
     "megabatch_amortized_slot_seconds", "slot_verify_latency_seconds",
+    "admitted_verdict_latency_seconds", "megabatch_occupancy",
 )
 
 #: every declared span name (the slot-lifecycle trace taxonomy) ->

@@ -9,6 +9,7 @@ TPU scope, SURVEY §5).
 
 from __future__ import annotations
 
+import os
 import time
 
 from ..blockchain import BlockchainService, EventFeed
@@ -66,6 +67,29 @@ class BeaconNode:
         self.att_pool = AttestationPool()
         self.slashing_pool = SlashingPool()
         self.exit_pool = VoluntaryExitPool()
+
+        # overload-control plane: ONE admission controller at the
+        # ingress edge, shared by the RPC submission paths (via
+        # node.admission) and the pool's own gossip/sync-facing gate;
+        # the depth auto-tuner replaces static set_depth calls, ticked
+        # from the slot loop
+        from ..runtime.admission import AdmissionController
+        from ..sched.autotune import DepthAutoTuner
+
+        self.admission = AdmissionController(
+            scheduler=self.chain.scheduler)
+        self.att_pool.admission = self.admission
+        self.autotuner = DepthAutoTuner(self.chain.scheduler,
+                                        register_flight=True)
+        # slot-tick-derived deadlines are OPT-IN (a first fused-graph
+        # compile can take minutes; shedding real work on it would be
+        # wrong): PRYSM_TPU_SLOT_DEADLINE_S=<seconds> or "tick" (one
+        # slot duration)
+        deadline_env = os.environ.get("PRYSM_TPU_SLOT_DEADLINE_S")
+        if deadline_env:
+            self.chain.scheduler.default_deadline_s = (
+                float(beacon_config().seconds_per_slot)
+                if deadline_env == "tick" else float(deadline_env))
 
         self.peer = bus.join(node_id)
         self.sync = SyncService(self.peer, self.chain, self.att_pool,
@@ -132,6 +156,9 @@ class BeaconNode:
         # megabatch never holds a verdict past linger_s just because
         # traffic went thin
         self.chain.scheduler.poll()
+        # depth auto-tuning off the same tick: backlog raises N,
+        # drain/linger drops it back toward 1
+        self.autotuner.tick()
         self.sync.retry_pending()
         self.att_pool.aggregate_unaggregated()
         if slot >= 1:

@@ -68,13 +68,24 @@ class AttestationPool:
         # registry-wide device pubkey table for the indexed slot path
         # (lazy: stays empty under the pure backend)
         self.pubkey_table = bls.PubkeyTable()
+        # ingress admission gate (the node wires its controller here;
+        # None = ungated — standalone pools, direct-pool tests).
+        # Guards the paths that DON'T pass through the API edge
+        # (gossip, sync replays); API submissions arrive context-
+        # marked admitted, so they are never double-charged.
+        self.admission = None
 
     # --- ingest ------------------------------------------------------------
+
+    def _admit(self) -> None:
+        if self.admission is not None:
+            self.admission.admit()
 
     def save_unaggregated(self, att: Attestation) -> None:
         if sum(att.aggregation_bits) != 1:
             raise AttestationPoolError(
                 "unaggregated attestation must have exactly one bit")
+        self._admit()
         with _tracing.span("pool.ingress"), self._lock:
             g = self._groups[_group_key(att)]
             if any(att.aggregation_bits == e.aggregation_bits
@@ -85,6 +96,7 @@ class AttestationPool:
     def save_aggregated(self, att: Attestation) -> None:
         if sum(att.aggregation_bits) < 1:
             raise AttestationPoolError("empty aggregation bits")
+        self._admit()
         with _tracing.span("pool.ingress"), self._lock:
             g = self._groups[_group_key(att)]
             # drop if already covered by an existing aggregate

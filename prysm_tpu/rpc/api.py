@@ -43,6 +43,16 @@ class ValidatorAPI:
     def __init__(self, node):
         self.node = node
 
+    def _admitted(self):
+        """Admission gate for a submission path: charge the calling
+        client's credit ONCE here, then mark the context admitted so
+        the pool's own ingress gate (which also guards gossip/sync)
+        does not double-charge the same submission.  A node without an
+        admission controller wired (direct-API tests) is a no-op."""
+        from ..runtime.admission import admitted_span
+
+        return admitted_span(getattr(self.node, "admission", None))
+
     # --- duties ------------------------------------------------------------
 
     def get_duties(self, epoch: int, pubkeys: list[bytes]) -> list[Duty]:
@@ -213,11 +223,13 @@ class ValidatorAPI:
         """ProposeBeaconBlock analog: full verification + broadcast."""
         from ..p2p.bus import TOPIC_BLOCK
 
-        root = self.node.chain.receive_block(signed_block)
-        self.node.peer.broadcast(
-            TOPIC_BLOCK,
-            self.node.types.SignedBeaconBlock.serialize(signed_block))
-        return root
+        with self._admitted():
+            root = self.node.chain.receive_block(signed_block)
+            self.node.peer.broadcast(
+                TOPIC_BLOCK,
+                self.node.types.SignedBeaconBlock.serialize(
+                    signed_block))
+            return root
 
     # --- attestations ------------------------------------------------------
 
@@ -257,14 +269,16 @@ class ValidatorAPI:
         from ..core.helpers import compute_subnet_for_attestation
         from ..p2p.bus import attestation_subnet_topic
 
-        if sum(att.aggregation_bits) == 1:
-            self.node.att_pool.save_unaggregated(att)
-        else:
-            self.node.att_pool.save_aggregated(att)
-        subnet = compute_subnet_for_attestation(
-            self.node.chain.head_state, att.data.slot, att.data.index)
-        self.node.peer.broadcast(attestation_subnet_topic(subnet),
-                                 Attestation.serialize(att))
+        with self._admitted():
+            if sum(att.aggregation_bits) == 1:
+                self.node.att_pool.save_unaggregated(att)
+            else:
+                self.node.att_pool.save_aggregated(att)
+            subnet = compute_subnet_for_attestation(
+                self.node.chain.head_state, att.data.slot,
+                att.data.index)
+            self.node.peer.broadcast(attestation_subnet_topic(subnet),
+                                     Attestation.serialize(att))
 
     def get_aggregate_attestation(self, slot: int,
                                   committee_index: int):
@@ -290,9 +304,11 @@ class ValidatorAPI:
         from ..p2p.bus import TOPIC_AGGREGATE
         from ..proto import SignedAggregateAndProof
 
-        self.node.att_pool.save_aggregated(signed.message.aggregate)
-        self.node.peer.broadcast(
-            TOPIC_AGGREGATE, SignedAggregateAndProof.serialize(signed))
+        with self._admitted():
+            self.node.att_pool.save_aggregated(signed.message.aggregate)
+            self.node.peer.broadcast(
+                TOPIC_AGGREGATE,
+                SignedAggregateAndProof.serialize(signed))
 
     def domain_data(self, epoch: int, domain_type: bytes) -> bytes:
         """DomainData analog: the signing domain for (epoch, type)

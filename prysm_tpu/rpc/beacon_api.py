@@ -395,29 +395,40 @@ class BeaconAPI:
             _hex(SignedVoluntaryExit.serialize(e))
             for e in self.node.exit_pool.pending()]}
 
+    def _admitted(self):
+        """Same ingress gate as ``ValidatorAPI._admitted``: charge the
+        submitting client once, mark the context admitted for nested
+        pool gates; no-op when no controller is wired."""
+        from ..runtime.admission import admitted_span
+
+        return admitted_span(getattr(self.node, "admission", None))
+
     def submit_voluntary_exit(self, raw: bytes) -> None:
         from ..proto import SignedVoluntaryExit
 
         exit_ = SignedVoluntaryExit.deserialize(raw)
-        if not self.node.exit_pool.insert(
-                self.node.chain.head_state, exit_):
-            raise APIError("exit rejected")
+        with self._admitted():
+            if not self.node.exit_pool.insert(
+                    self.node.chain.head_state, exit_):
+                raise APIError("exit rejected")
 
     def submit_attester_slashing(self, raw: bytes) -> None:
         from ..proto import AttesterSlashing
 
         sl = AttesterSlashing.deserialize(raw)
-        if not self.node.slashing_pool.insert_attester_slashing(
-                self.node.chain.head_state, sl):
-            raise APIError("slashing rejected")
+        with self._admitted():
+            if not self.node.slashing_pool.insert_attester_slashing(
+                    self.node.chain.head_state, sl):
+                raise APIError("slashing rejected")
 
     def submit_proposer_slashing(self, raw: bytes) -> None:
         from ..proto import ProposerSlashing
 
         sl = ProposerSlashing.deserialize(raw)
-        if not self.node.slashing_pool.insert_proposer_slashing(
-                self.node.chain.head_state, sl):
-            raise APIError("slashing rejected")
+        with self._admitted():
+            if not self.node.slashing_pool.insert_proposer_slashing(
+                    self.node.chain.head_state, sl):
+                raise APIError("slashing rejected")
 
     # --- config -------------------------------------------------------------
 

@@ -71,10 +71,19 @@ class GrpcValidatorServer:
         the same framing the generated servicer would produce."""
 
         def call(request: bytes, context: grpc.ServicerContext) -> bytes:
+            from ..runtime.admission import (
+                AdmissionRejected, client_context,
+            )
+
             try:
-                return fn(request).SerializeToString()
+                with client_context(context.peer()):
+                    return fn(request).SerializeToString()
             except RpcError as e:
                 context.abort(_to_grpc_code(e.code), str(e))
+            except AdmissionRejected as e:
+                # str(e) carries retry_after_s=... for the client
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              str(e))
             except APIError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             except Exception as e:              # noqa: BLE001

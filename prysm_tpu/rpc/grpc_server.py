@@ -37,6 +37,7 @@ SERVICE = "/prysm_tpu.v1alpha1.BeaconNodeValidator/"
 OK = 0
 INVALID_ARGUMENT = 3
 NOT_FOUND = 5
+RESOURCE_EXHAUSTED = 8    # admission rejection: back off and retry
 INTERNAL = 13
 
 _MAX_FRAME = 1 << 26          # 64 MiB: a mainnet state fits; junk won't
@@ -196,11 +197,17 @@ class ValidatorRpcServer:
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                from ..runtime.admission import client_context
+
+                # per-connection peer identity: the admission
+                # controller's fairness buckets key off it
+                peer = "%s:%s" % self.client_address[:2]
                 try:
-                    while True:
-                        frame = _recv_frame(self.request)
-                        resp = outer._dispatch(frame)
-                        _send_frame(self.request, resp)
+                    with client_context(peer):
+                        while True:
+                            frame = _recv_frame(self.request)
+                            resp = outer._dispatch(frame)
+                            _send_frame(self.request, resp)
                 except (ConnectionError, OSError):
                     return
 
@@ -238,11 +245,18 @@ class ValidatorRpcServer:
         handler = self._handlers.get(method[len(SERVICE):])
         if handler is None:
             return self._error(NOT_FOUND, f"unknown method: {method}")
+        from ..runtime.admission import AdmissionRejected
+
         try:
             msg = handler(payload)
             return bytes([OK]) + msg.SerializeToString()
         except RpcError as e:
             return self._error(e.code, str(e))
+        except AdmissionRejected as e:
+            # explicit backpressure, never a silent drop: the message
+            # carries the retry_after_s=... hint for the client's
+            # jittered backoff
+            return self._error(RESOURCE_EXHAUSTED, str(e))
         except APIError as e:
             return self._error(INVALID_ARGUMENT, str(e))
         except Exception as e:                  # noqa: BLE001

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import math
 import queue
 import threading
 import time
@@ -64,13 +65,16 @@ class BeaconHTTPServer:
             def log_message(self, fmt, *args):   # quiet test output
                 pass
 
-            def _send(self, code: int, body, content_type="application/json"):
+            def _send(self, code: int, body,
+                      content_type="application/json", headers=()):
                 data = (json.dumps(body).encode()
                         if content_type == "application/json"
                         else body.encode())
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
+                for name, value in headers:
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -83,8 +87,20 @@ class BeaconHTTPServer:
                     self._send(500, {"error": repr(e)})
 
             def do_POST(self):
+                from ..runtime.admission import (
+                    AdmissionRejected, client_context,
+                )
+
                 try:
-                    outer._handle_post(self)
+                    with client_context(self.client_address[0]):
+                        outer._handle_post(self)
+                except AdmissionRejected as e:
+                    # REST backpressure: 429 + Retry-After (whole
+                    # seconds, ceil) + the precise hint in the body
+                    retry = max(1, math.ceil(e.retry_after_s))
+                    self._send(429, {"error": str(e),
+                                     "retry_after_s": e.retry_after_s},
+                               headers=(("Retry-After", str(retry)),))
                 except _CLIENT_ERRORS as e:
                     self._send(400, {"error": repr(e)})
                 except Exception as e:  # noqa: BLE001

@@ -1,0 +1,241 @@
+"""Admission control at the verification front end's ingress.
+
+Everything below the RPC edge — the attestation pool, the megabatch
+accumulator, the slot dispatcher — degrades *gracefully* once work is
+inside (retry ladder, bisection, fail-closed close).  Nothing protects
+those stages from the traffic side: a burst of client submissions
+grows ``MegabatchAccumulator._pending`` and the RPC queues without
+bound.  The :class:`AdmissionController` is the single gate at the
+edge: it admits a submission only while the scheduler backlog and the
+observed queue-wait p90 are inside their bounds AND the submitting
+client has fairness credits left.  A refusal is never a silent drop —
+it raises :class:`AdmissionRejected` carrying an explicit
+``retry_after_s`` hint, which every RPC carrier maps onto its native
+"come back later" shape (HTTP 429 + ``Retry-After``, gRPC
+``RESOURCE_EXHAUSTED``).
+
+Two pieces of ambient context ride on contextvars so the gate composes
+across layers without threading arguments through every signature:
+
+* :func:`client_context` — the RPC carrier tags the handling thread
+  with the remote peer's identity; per-client token buckets key off it
+  (anonymous ingress shares one bucket).
+* the *admitted* flag — ``ValidatorAPI`` charges a submission ONCE at
+  the API edge and then marks the context admitted, so the pool's own
+  ingress gate (which also guards gossip/sync paths that never pass
+  through the API) does not double-charge the same submission.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import re
+import threading
+import time
+from contextlib import contextmanager
+
+from ..monitoring import flight as _flight
+from ..monitoring.metrics import metrics as _metrics
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "admitted_span",
+    "client_context",
+    "current_client",
+    "retry_after_from",
+]
+
+_RETRY_AFTER_RE = re.compile(r"retry_after_s=([0-9]+(?:\.[0-9]+)?)")
+
+_client_var: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "prysm_admission_client", default=None)
+_admitted_var: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "prysm_admission_admitted", default=False)
+
+
+class AdmissionRejected(Exception):
+    """A submission refused at ingress — with an explicit retry hint.
+
+    The message embeds ``retry_after_s=<float>`` in a stable wire
+    format so carriers that can only transport a string (the framed
+    gRPC-alike, the real-grpc abort details) still deliver the hint;
+    :func:`retry_after_from` parses it back out on the client side.
+    """
+
+    def __init__(self, reason: str, retry_after_s: float):
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(
+            f"admission rejected ({reason}); "
+            f"retry_after_s={self.retry_after_s:.3f}")
+
+
+def retry_after_from(message: str) -> float | None:
+    """Parse the ``retry_after_s=`` hint back out of a carried error
+    string; None when the string does not carry one."""
+    m = _RETRY_AFTER_RE.search(message)
+    return float(m.group(1)) if m else None
+
+
+def current_client() -> str | None:
+    return _client_var.get()
+
+
+@contextmanager
+def client_context(client_id: str):
+    """Tag the current context with the submitting client's identity
+    (RPC carriers wrap each connection/request in this)."""
+    token = _client_var.set(client_id)
+    try:
+        yield
+    finally:
+        _client_var.reset(token)
+
+
+@contextmanager
+def admitted_span(controller: "AdmissionController | None"):
+    """Charge admission once, then mark the context admitted for the
+    duration of the body so nested gates (the pool's) are no-ops.
+
+    With ``controller=None`` (no admission wired — direct-API tests,
+    standalone pools) this is a transparent no-op.
+    """
+    if controller is None:
+        yield
+        return
+    controller.admit()
+    token = _admitted_var.set(True)
+    try:
+        yield
+    finally:
+        _admitted_var.reset(token)
+
+
+class AdmissionController:
+    """Token/credit gate for the submission ingress.
+
+    Two checks, in order:
+
+    1. **Global saturation** — refuse everyone while
+       ``scheduler.pending()`` is at/over ``max_pending`` or the
+       ``stage_queue_wait_seconds`` p90 exceeds
+       ``queue_wait_p90_s``.  The retry hint scales with how far over
+       the bound the backlog is.
+    2. **Per-client fairness credits** — a token bucket per client
+       identity (``credits_per_client`` burst, ``refill_per_s``
+       sustained rate) so one hog cannot starve the rest even while
+       the node as a whole has headroom.
+
+    Rejections are episodic for the flight recorder: the FIRST
+    rejection episode per controller (reset via
+    :meth:`reset_episodes`, which soaks call per run) forces a black
+    box dump; later episodes fall back to the recorder's own rate
+    limit.
+    """
+
+    def __init__(self, scheduler=None, *, max_pending: int = 256,
+                 queue_wait_p90_s: float = 2.0,
+                 credits_per_client: float = 64.0,
+                 refill_per_s: float = 32.0,
+                 register_flight: bool = True):
+        self.scheduler = scheduler
+        self.max_pending = int(max_pending)
+        self.queue_wait_p90_s = float(queue_wait_p90_s)
+        self.credits_per_client = float(credits_per_client)
+        self.refill_per_s = float(refill_per_s)
+        # RLock: the credits branch of admit() calls _reject() while
+        # already holding the lock.
+        self._lock = threading.RLock()
+        self._buckets: dict[str, list[float]] = {}   # id -> [credits, t]
+        self._in_episode = False
+        self._episodes = 0
+        if register_flight:
+            _flight.register_provider("admission", self.snapshot)
+
+    # -- load inputs -----------------------------------------------------
+
+    def load(self) -> dict:
+        pending = 0
+        if self.scheduler is not None:
+            try:
+                pending = int(self.scheduler.pending())
+            except Exception:   # noqa: BLE001 - closed scheduler etc.
+                pending = 0
+        p90 = _metrics.histogram("stage_queue_wait_seconds").quantile(0.9)
+        return {"pending": pending, "queue_wait_p90_s": p90}
+
+    # -- the gate --------------------------------------------------------
+
+    def admit(self, client_id: str | None = None, cost: float = 1.0) -> None:
+        """Admit one submission or raise :class:`AdmissionRejected`.
+
+        A context already marked admitted (the API charged it) passes
+        through for free — that is what makes the API-edge gate and
+        the pool-ingress gate compose instead of double-charging.
+        """
+        if _admitted_var.get():
+            return
+        client = client_id or current_client() or "anon"
+        load = self.load()
+        pending, p90 = load["pending"], load["queue_wait_p90_s"]
+        if pending >= self.max_pending or p90 > self.queue_wait_p90_s:
+            over = pending / max(1, self.max_pending)
+            retry = min(5.0, max(0.05, max(p90, 0.05) * max(1.0, over)))
+            self._reject(client, "saturated", retry, load)
+        with self._lock:
+            now = time.monotonic()
+            bucket = self._buckets.setdefault(
+                client, [self.credits_per_client, now])
+            credits, last = bucket
+            credits = min(self.credits_per_client,
+                          credits + (now - last) * self.refill_per_s)
+            bucket[1] = now
+            if credits < cost:
+                bucket[0] = credits
+                retry = (cost - credits) / max(1e-9, self.refill_per_s)
+                self._reject(client, "credits", min(5.0, retry), load)
+            bucket[0] = credits - cost
+            self._in_episode = False
+        _metrics.inc("admission_admits")
+
+    def _reject(self, client: str, reason: str, retry_after: float,
+                load: dict) -> None:
+        _metrics.inc("admission_rejections")
+        with self._lock:
+            first_of_episode = not self._in_episode
+            self._in_episode = True
+            if first_of_episode:
+                self._episodes += 1
+            force = first_of_episode and self._episodes == 1
+        _flight.note("admission_rejected", client=client, reason=reason,
+                     retry_after_s=round(retry_after, 3), **load)
+        if first_of_episode:
+            _flight.dump("admission_rejection", force=force)
+        raise AdmissionRejected(reason, retry_after)
+
+    # -- introspection ---------------------------------------------------
+
+    def reset_episodes(self) -> None:
+        """Re-arm the forced first-episode flight dump (per soak run)."""
+        with self._lock:
+            self._in_episode = False
+            self._episodes = 0
+
+    def snapshot(self) -> dict:
+        """State for ``/debug/flight`` black boxes."""
+        load = self.load()
+        with self._lock:
+            buckets = {c: round(b[0], 2) for c, b in self._buckets.items()}
+            episodes = self._episodes
+            in_episode = self._in_episode
+        return {
+            "pending": load["pending"],
+            "queue_wait_p90_s": round(load["queue_wait_p90_s"], 6),
+            "max_pending": self.max_pending,
+            "queue_wait_threshold_s": self.queue_wait_p90_s,
+            "clients": len(buckets),
+            "credits": buckets,
+            "rejection_episodes": episodes,
+            "in_rejection_episode": in_episode,
+        }

@@ -26,6 +26,14 @@ poisoning                  invalid-signature poisoning inside megabatches
                            (:func:`poison_signature`); the scheduler's
                            on-device bisection rung isolates the bad
                            entries (``bisection_isolations``)
+:class:`OverloadStorm`     seeded ingress bursts at a multiple of the
+                           claim budget, skewed toward one greedy
+                           client — drives the admission controller
+                           into explicit rejection
+                           (``admission_rejections``)
+:class:`SlowClient`        work whose deadlines expire while queued —
+                           drives the accumulator's shed-before-
+                           dispatch path (``shed_deadline_exceeded``)
 =========================  ==============================================
 
 The **soak harness** (:func:`run_soak`) composes all of them with a
@@ -68,7 +76,10 @@ def _register_counters() -> None:
     m = _metrics()
     for c in ("reorgs_applied", "slashings_injected",
               "registry_churn_events", "bisection_isolations",
-              "bisection_device_verifies", "soak_slots"):
+              "bisection_device_verifies", "soak_slots",
+              "admission_admits", "admission_rejections",
+              "shed_deadline_exceeded", "dispatch_deadline_refusals",
+              "depth_autotune_raise", "depth_autotune_lower"):
         m.inc(c, 0)
 
 
@@ -676,8 +687,303 @@ def run_soak(n_slots: int = 64, seed: int = 1337, depth: int = 4,
     }
 
 
+# --- overload scenarios (PR 12) ---------------------------------------------
+
+
+class OverloadStorm:
+    """Open-loop ingress burst generator: per step, a seeded burst of
+    ~``base_rate * saturation`` submissions spread over ``n_clients``
+    client ids, with one greedy client (``client-0``) sending about
+    half the traffic — the shape the admission controller's per-client
+    credits have to absorb without starving the polite clients.
+
+    Pure and deterministic for a seed: :meth:`burst` only decides WHO
+    submits WHAT; the harness owns admission, submission and claiming.
+    """
+
+    def __init__(self, n_clients: int = 4, base_rate: int = 2,
+                 saturation: float = 4.0, seed: int = 1337):
+        self.n_clients = max(2, n_clients)
+        self.base_rate = base_rate
+        self.saturation = saturation
+        self.seed = seed
+        self.generated = 0
+        self.per_client: dict[str, int] = {}
+
+    def burst(self, step: int) -> list[str]:
+        """Client ids for this step's submissions, one per entry."""
+        digest = _h(self.seed, "overload", step)
+        n = max(1, round(self.base_rate * self.saturation)
+                + digest[0] % 3 - 1)
+        ids = []
+        for i in range(n):
+            b = digest[1 + i % 30]
+            cid = ("client-0" if b % 2 == 0
+                   else "client-%d" % (1 + b % (self.n_clients - 1)))
+            ids.append(cid)
+            self.per_client[cid] = self.per_client.get(cid, 0) + 1
+        self.generated += n
+        return ids
+
+
+class SlowClient:
+    """A client whose work goes stale while queued: every submission
+    carries a deadline shorter than the lag before it lets the
+    accumulator flush, so the scheduler MUST shed the entries at the
+    demand flush instead of dispatching them — the queued-expiry path,
+    deterministic and independent of device-compute estimates."""
+
+    def __init__(self, scheduler, deadline_s: float = 0.02,
+                 lag_s: float = 0.05):
+        self.scheduler = scheduler
+        self.deadline_s = deadline_s
+        self.lag_s = lag_s
+        self.handles: list[tuple[int, list]] = []
+        self.submitted = 0
+
+    def submit(self, batch, golden) -> int:
+        h = self.scheduler.submit(
+            batch, deadline=time.monotonic() + self.deadline_s)
+        self.handles.append((h, golden))
+        self.submitted += 1
+        return h
+
+    def go_stale(self) -> None:
+        """Sleep past every queued deadline, then demand a flush: the
+        entries expire in the accumulator and are shed, never
+        dispatched."""
+        time.sleep(self.lag_s + self.deadline_s)
+        self.scheduler.flush()
+
+
+def _p99(samples) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+def run_overload(n_steps: int = 40, seed: int = 1337,
+                 n_clients: int = 4, saturation: float = 4.0,
+                 base_rate: int = 2, n_validators: int = 16,
+                 atts_per_slot: int = 2, poison_rate: float = 0.08,
+                 max_pending: int = 16, claim_lag: int = 8,
+                 deadline_s: float = 0.25, max_depth: int = 8,
+                 warmup: int = 8, stale_entries: int = 3,
+                 deadline_budget_s: float | None = None) -> dict:
+    """Overload soak: a seeded :class:`OverloadStorm` at ``saturation``x
+    the claim budget through a real ``StreamScheduler`` behind a real
+    ``AdmissionController`` and ``DepthAutoTuner``, then a
+    :class:`SlowClient` stale-work phase, then drain + cooldown.
+
+    Phases and what each one proves:
+
+    1. **warmup** — unloaded submissions establish the baseline
+       admitted-work latency (p99 of
+       ``admitted_verdict_latency_seconds``);
+    2. **storm** — every submission passes ``admission.admit(client)``
+       first; rejected work never reaches the scheduler, admitted work
+       carries a deadline; the auto-tuner ticks every step and the
+       depth trace must reach ``max_depth`` under backlog;
+    3. **stale** — the slow client's queued entries expire and are
+       shed at the demand flush (plus one expired-at-submit entry shed
+       without ever touching the accumulator);
+    4. **drain + cooldown** — every handle claimed (so
+       ``fail_closed_abandons`` delta MUST be 0) and the auto-tuner
+       must decay the depth back down with the load gone.
+
+    The report's central invariant is the overload ledger —
+    ``rejections + sheds + verdicts == submissions`` — every
+    submission ends in exactly one explicit bucket; nothing vanishes.
+    ``shed_accounting_ok`` pins the shed count to the observed
+    False-verdicts-on-golden-True (a shed fails closed, visibly).
+    """
+    from ..crypto.bls import bls
+    from ..sched import StreamScheduler
+    from ..sched.autotune import DepthAutoTuner
+    from .admission import AdmissionController, AdmissionRejected
+
+    m = _metrics()
+    before = {c: _counter(c) for c in (
+        "admission_admits", "admission_rejections",
+        "shed_deadline_exceeded", "dispatch_deadline_refusals",
+        "depth_autotune_raise", "depth_autotune_lower",
+        "fail_closed_abandons", "megabatch_dispatches")}
+    hist = m.histogram("admitted_verdict_latency_seconds")
+    verdicts_before = hist.n
+    bls.fused_breaker.reset()
+
+    table = bls.PubkeyTable()
+    storm = OverloadStorm(n_clients=n_clients, base_rate=base_rate,
+                          saturation=saturation, seed=seed)
+    scheduler = StreamScheduler(max_slots=1, linger_s=300.0)
+    admission = AdmissionController(scheduler=scheduler,
+                                    max_pending=max_pending)
+    admission.reset_episodes()
+    tuner = DepthAutoTuner(scheduler, max_depth=max_depth,
+                           register_flight=True)
+
+    # storm deadlines are generous relative to the device-compute p90
+    # estimate the dispatcher refuses against (a pytest process may
+    # carry multi-second compile samples in that histogram): the
+    # DETERMINISTIC shed demonstration is the stale phase, which only
+    # depends on queued-expiry
+    est = m.histogram("stage_device_compute_seconds").quantile(0.9)
+    storm_deadline_s = max(deadline_s, 20.0 * est)
+
+    submissions = 0
+    rejections = 0
+    outstanding: list[tuple[int, list]] = []
+    divergences: list[str] = []
+    false_on_true = 0
+    depth_trace: list[int] = []
+    steps_run = 0
+    partial = False
+    slot_counter = 0
+    t0 = time.monotonic()
+
+    def _claim_one() -> None:
+        nonlocal false_on_true
+        handle, golden = outstanding.pop(0)
+        got = bool(scheduler.result(handle))
+        want = all(golden)
+        if got and not want:
+            divergences.append(
+                f"handle {handle}: verdict True but golden has a "
+                f"poisoned entry")
+        elif want and not got:
+            # fail-closed False on golden-True work: legal ONLY as a
+            # deadline shed — reconciled against the shed counter below
+            false_on_true += 1
+
+    def _submit_one(client_id: str, deadline: float | None) -> None:
+        nonlocal submissions, rejections, slot_counter
+        submissions += 1
+        try:
+            admission.admit(client_id)
+        except AdmissionRejected:
+            rejections += 1
+            return
+        digest = _h(seed, "poison", slot_counter)
+        poisoned = (0,) if digest[0] / 255.0 < poison_rate else ()
+        batch, golden = build_synthetic_batch(
+            table, slot_counter, atts_per_slot, n_validators,
+            seed=seed, poisoned=poisoned)
+        slot_counter += 1
+        # poisoned batches carry NO deadline so a golden-False entry
+        # can never be shed — keeps false_on_true == sheds exact
+        dl = None if poisoned else deadline
+        outstanding.append((scheduler.submit(batch, deadline=dl),
+                            golden))
+
+    try:
+        with synthetic_crypto():
+            # 1. warmup: unloaded latency baseline (depth 1 → each
+            # submission flushes + dispatches immediately)
+            lat0 = len(hist.samples)
+            for _ in range(warmup):
+                _submit_one("warmup", None)
+                scheduler.flush()
+                while outstanding:
+                    _claim_one()
+            lat1 = len(hist.samples)
+
+            # 2. storm at saturation-x with bounded claim lag
+            for step in range(n_steps):
+                if deadline_budget_s is not None and (
+                        time.monotonic() - t0) > deadline_budget_s:
+                    partial = True
+                    break
+                for cid in storm.burst(step):
+                    _submit_one(
+                        cid, time.monotonic() + storm_deadline_s)
+                tuner.tick()
+                depth_trace.append(scheduler.max_slots)
+                while len(outstanding) > claim_lag:
+                    _claim_one()
+                steps_run += 1
+            scheduler.flush()
+            while outstanding:
+                _claim_one()
+            lat2 = len(hist.samples)
+
+            # 3. stale work: one expired-at-submit shed, then the slow
+            # client's queued entries expiring before its flush.  All
+            # stale entries are clean (never poisoned) and the queue
+            # stays strictly under the depth so nothing auto-flushes
+            # before it expires — the sheds here are deterministic.
+            scheduler.set_depth(stale_entries + 2)
+
+            def _stale_batch():
+                nonlocal submissions, slot_counter
+                submissions += 1
+                admission.admit("slow-client")
+                batch, golden = build_synthetic_batch(
+                    table, slot_counter, atts_per_slot, n_validators,
+                    seed=seed)
+                slot_counter += 1
+                return batch, golden
+
+            batch, golden = _stale_batch()
+            outstanding.append((scheduler.submit(
+                batch, deadline=time.monotonic() - 0.001), golden))
+            slow = SlowClient(scheduler)
+            for _ in range(stale_entries):
+                slow.submit(*_stale_batch())
+            slow.go_stale()
+            outstanding.extend(slow.handles)
+            while outstanding:
+                _claim_one()
+
+            # 4. cooldown: load gone, the tuner must decay the depth
+            for _ in range(6):
+                tuner.tick()
+            scheduler.close()
+    finally:
+        bls.fused_breaker.reset()
+
+    delta = {c: _counter(c) - before[c] for c in before}
+    verdicts = hist.n - verdicts_before
+    sheds = delta["shed_deadline_exceeded"]
+    unloaded = list(hist.samples[lat0:lat1])
+    loaded = list(hist.samples[lat1:lat2])
+    unloaded_p99 = _p99(unloaded)
+    loaded_p99 = _p99(loaded)
+    elapsed = time.monotonic() - t0
+    return {
+        "steps": steps_run,
+        "partial": partial,
+        "elapsed_s": round(elapsed, 3),
+        "submissions": submissions,
+        "rejections": rejections,
+        "admitted": submissions - rejections,
+        "sheds": int(sheds),
+        "dispatch_refusals": int(delta["dispatch_deadline_refusals"]),
+        "verdicts": int(verdicts),
+        "accounting_ok": rejections + sheds + verdicts == submissions,
+        "shed_accounting_ok": false_on_true == sheds,
+        "false_on_true": false_on_true,
+        "divergences": divergences,
+        "fail_closed_abandons": int(delta["fail_closed_abandons"]),
+        "unloaded_p99_s": round(unloaded_p99, 6),
+        "loaded_p99_s": round(loaded_p99, 6),
+        "latency_ratio": round(
+            loaded_p99 / max(unloaded_p99, 0.005), 3),
+        "deadline_s": round(storm_deadline_s, 3),
+        "depth": {
+            "max_reached": max(depth_trace) if depth_trace else 1,
+            "final": scheduler.max_slots,
+            "raises": int(delta["depth_autotune_raise"]),
+            "lowers": int(delta["depth_autotune_lower"]),
+        },
+        "admission": admission.snapshot(),
+        "clients": dict(sorted(storm.per_client.items())),
+    }
+
+
 __all__ = [
-    "ReorgStorm", "SlashingFlood", "RegistryChurn", "ScenarioSchedule",
-    "build_synthetic_batch", "poison_signature", "run_soak",
+    "OverloadStorm", "ReorgStorm", "SlashingFlood", "RegistryChurn",
+    "ScenarioSchedule", "SlowClient", "build_synthetic_batch",
+    "poison_signature", "run_overload", "run_soak",
     "synthetic_crypto", "synthetic_pubkey", "synthetic_signature",
 ]

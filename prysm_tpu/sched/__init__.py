@@ -16,6 +16,7 @@ latency identical to the fused per-slot path), N=16+ for initial
 sync, epoch replay, and backfill (amortizes the dispatch floor away).
 """
 
+from .autotune import DepthAutoTuner  # noqa: F401
 from .megabatch import (  # noqa: F401
     FLUSH_CLOSE, FLUSH_DEMAND, FLUSH_FULL, FLUSH_LINGER,
     FLUSH_TABLE_SWITCH, Megabatch, MegabatchAccumulator, join_batches,

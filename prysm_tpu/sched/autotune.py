@@ -1,0 +1,105 @@
+"""Occupancy-driven auto-tuning of the megabatch depth N.
+
+The sync/initial and epoch-replay paths used to pin the scheduler to a
+static ``set_depth(16)`` for their whole span.  That is the wrong depth
+on both sides: during a trickle, deep megabatches linger (the PR-11
+``megabatch_linger_seconds`` histogram is exactly the cost of waiting
+for occupancy that never comes); during a backlog, a shallow depth
+wastes the amortization the fused graph exists for.
+
+:class:`DepthAutoTuner` is a small hysteresis-band controller ticked
+by the owner of the scheduler (per submitted block on the sync path,
+per slot tick on the node).  Multiplicative raise under backlog,
+multiplicative decay toward ``min_depth`` when the pipeline drains —
+AIMD-shaped, but symmetric-multiplicative because depth is itself a
+power-of-two-ish batching knob:
+
+* ``pending > depth``          → double toward ``max_depth``
+  (the accumulator is refilling faster than a full megabatch drains).
+* ``pending <= depth // 2``    → halve toward ``min_depth``
+  (occupancy can no longer fill the current depth; linger would
+  dominate — better to dispatch shallow and keep latency).
+* anything in between          → hold (the hysteresis band; prevents
+  flapping when the backlog hovers near the depth).
+
+The PR-3 breaker-open demotion keeps ABSOLUTE priority: while the
+fused-dispatch breaker is open the tuner forces ``min_depth`` and
+refuses to raise, matching the scheduler's own per-submit demotion.
+
+Decision inputs (backlog plus the occupancy/linger/queue-wait
+quantiles) are kept from the last tick and exposed via
+:meth:`snapshot` so ``/debug/flight`` black boxes and the bench tier
+JSON can show *why* the depth is what it is.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..monitoring import flight as _flight
+from ..monitoring.metrics import metrics as _metrics
+
+__all__ = ["DepthAutoTuner"]
+
+
+class DepthAutoTuner:
+    def __init__(self, scheduler, *, min_depth: int = 1,
+                 max_depth: int = 16, cooldown_s: float = 0.0,
+                 register_flight: bool = False):
+        self.scheduler = scheduler
+        self.min_depth = max(1, int(min_depth))
+        self.max_depth = max(self.min_depth, int(max_depth))
+        self.cooldown_s = float(cooldown_s)
+        self._last_change = 0.0
+        self._last: dict = {}
+        if register_flight:
+            _flight.register_provider("depth_autotuner", self.snapshot)
+
+    def tick(self) -> int:
+        """Observe, maybe resize, return the (possibly new) depth."""
+        now = time.monotonic()
+        sched = self.scheduler
+        depth = sched.max_slots
+        pending = sched.pending()
+        self._last = {
+            "depth": depth,
+            "pending": pending,
+            "queue_wait_p90_s": round(_metrics.histogram(
+                "stage_queue_wait_seconds").quantile(0.9), 6),
+            "linger_p90_s": round(_metrics.histogram(
+                "megabatch_linger_seconds").quantile(0.9), 6),
+            "occupancy_p90": round(_metrics.histogram(
+                "megabatch_occupancy").quantile(0.9), 3),
+        }
+        if self._breaker_open():
+            # Breaker demotion has absolute priority over the band.
+            if depth > self.min_depth:
+                self._resize(self.min_depth, raise_=False, now=now)
+            return sched.max_slots
+        if self._last_change and now - self._last_change < self.cooldown_s:
+            return depth
+        if pending > depth and depth < self.max_depth:
+            self._resize(min(self.max_depth, depth * 2), raise_=True, now=now)
+        elif pending <= depth // 2 and depth > self.min_depth:
+            self._resize(max(self.min_depth, depth // 2), raise_=False,
+                         now=now)
+        return sched.max_slots
+
+    def _resize(self, n: int, *, raise_: bool, now: float) -> None:
+        self.scheduler.set_depth(n)
+        self._last_change = now
+        self._last["depth"] = n
+        if raise_:
+            _metrics.inc("depth_autotune_raise")
+        else:
+            _metrics.inc("depth_autotune_lower")
+        _metrics.set("depth_autotune_depth", float(n))
+
+    def _breaker_open(self) -> bool:
+        from ..crypto.bls.bls import fused_breaker
+        return fused_breaker.is_open()
+
+    def snapshot(self) -> dict:
+        """Last decision inputs, for /debug/flight and tier JSON."""
+        return dict(self._last,
+                    min_depth=self.min_depth, max_depth=self.max_depth)

@@ -68,12 +68,21 @@ def join_batches(batches):
 @dataclass
 class Megabatch:
     """One flushed unit of cross-slot work: the (handle, batch) slots
-    it covers, their join, and the flush decision that produced it."""
+    it covers, their join, and the flush decision that produced it.
+
+    ``shed`` carries the entries whose deadline had already passed at
+    flush time — they are NOT part of ``joined`` and never reach the
+    device; the scheduler settles them fail-closed-with-reason
+    (``shed_deadline_exceeded``).  ``deadline`` is the tightest live
+    entry's deadline (None when none carries one): the dispatcher uses
+    it to refuse tickets that cannot meet it."""
 
     entries: list          # [(handle:int, IndexedSlotBatch), ...]
     joined: object         # IndexedSlotBatch (fresh; see join_batches)
     reason: str
     created_at: float = field(default_factory=time.monotonic)
+    shed: list = field(default_factory=list)   # [(handle, batch), ...]
+    deadline: float | None = None              # min over live entries
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -94,21 +103,24 @@ class MegabatchAccumulator:
         assert max_slots >= 1
         self.max_slots = int(max_slots)
         self.linger_s = float(linger_s)
-        self._pending: list = []     # [(handle, batch, enq_t), ...]
+        # [(handle, batch, enq_t, deadline|None), ...]
+        self._pending: list = []
         self._oldest: float | None = None
 
     def __len__(self) -> int:
         return len(self._pending)
 
     def pending_handles(self) -> list:
-        return [h for h, _b, _t in self._pending]
+        return [h for h, _b, _t, _d in self._pending]
 
-    def add(self, handle: int, batch, max_slots: int | None = None
-            ) -> list:
+    def add(self, handle: int, batch, max_slots: int | None = None,
+            deadline: float | None = None) -> list:
         """Queue one slot's batch; returns the megabatches this add
         flushed (possibly empty).  ``max_slots`` overrides the
         configured knob for this call (breaker-open demotion to N=1
-        without losing the configured depth)."""
+        without losing the configured depth).  ``deadline`` is an
+        absolute ``time.monotonic()`` instant past which the entry is
+        shed at flush instead of dispatched."""
         limit = self.max_slots if max_slots is None else max(
             1, int(max_slots))
         out = []
@@ -121,7 +133,7 @@ class MegabatchAccumulator:
                 out.append(mb)
         if self._oldest is None:
             self._oldest = time.monotonic()
-        self._pending.append((handle, batch, time.monotonic()))
+        self._pending.append((handle, batch, time.monotonic(), deadline))
         if len(self._pending) >= limit:
             mb = self.flush(FLUSH_FULL)
             if mb is not None:
@@ -136,21 +148,30 @@ class MegabatchAccumulator:
 
     def flush(self, reason: str):
         """Join everything queued into one ``Megabatch``; None when
-        nothing is pending.  Every flush is a metric: the reason
-        counter and the occupancy histogram."""
+        nothing is pending.  Entries whose deadline already passed are
+        partitioned into ``Megabatch.shed`` BEFORE the join — they
+        never pay for device dispatch and do not count toward
+        occupancy or slots-dispatched.  Every flush is a metric: the
+        reason counter and the occupancy histogram."""
         if not self._pending:
             return None
         now = time.monotonic()
         entries, self._pending = self._pending, []
         oldest, self._oldest = self._oldest, None
-        joined = join_batches([b for _h, b, _t in entries])
+        live = [e for e in entries if e[3] is None or e[3] > now]
+        shed = [e for e in entries if not (e[3] is None or e[3] > now)]
+        joined = join_batches([b for _h, b, _t, _d in live])
         m = _metrics()
         m.inc(f"megabatch_flushes_{reason}")
-        m.observe("megabatch_occupancy", float(len(entries)))
-        m.inc("megabatch_slots_dispatched", len(entries))
+        if live:
+            m.observe("megabatch_occupancy", float(len(live)))
+            m.inc("megabatch_slots_dispatched", len(live))
         if oldest is not None:
             m.observe("megabatch_linger_seconds", now - oldest)
-        for _h, _b, t_enq in entries:
+        for _h, _b, t_enq, _d in live:
             m.observe("stage_queue_wait_seconds", now - t_enq)
-        return Megabatch(entries=[(h, b) for h, b, _t in entries],
-                         joined=joined, reason=reason)
+        dls = [d for _h, _b, _t, d in live if d is not None]
+        return Megabatch(entries=[(h, b) for h, b, _t, _d in live],
+                         joined=joined, reason=reason,
+                         shed=[(h, b) for h, b, _t, _d in shed],
+                         deadline=min(dls) if dls else None)

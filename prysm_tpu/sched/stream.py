@@ -37,6 +37,17 @@ undispatched slot AND every in-flight slot to a False verdict and
 counts each into ``fail_closed_abandons`` — a scheduler torn down
 mid-stream must never leave a slot's verdict implicitly "assumed
 verified" (or silently dropped with a dangling handle).
+
+Deadline shedding (the overload half of fail-closed): a submission
+may carry an absolute deadline (``submit(batch, deadline=...)``, or
+scheduler-wide via ``default_deadline_s`` — slot-tick derived when
+the node enables it).  Work whose deadline passes while still queued
+is SHED before paying for device dispatch: verdict False, counted
+into ``shed_deadline_exceeded`` — deliberately distinct from
+``fail_closed_abandons`` so "the node chose to drop late work" never
+masquerades as "the node lost work".  Admitted-and-dispatched work is
+never shed mid-flight: once a megabatch holds a ticket its verdicts
+are honored no matter how late they land.
 """
 
 from __future__ import annotations
@@ -48,7 +59,8 @@ from collections import deque
 from ..monitoring import tracing as _tracing
 from ..runtime import faults as _faults
 from .megabatch import (
-    FLUSH_CLOSE, FLUSH_DEMAND, FLUSH_LINGER, MegabatchAccumulator,
+    FLUSH_CLOSE, FLUSH_DEMAND, FLUSH_FULL, FLUSH_LINGER,
+    MegabatchAccumulator,
 )
 
 
@@ -74,7 +86,8 @@ class StreamScheduler:
     """
 
     def __init__(self, max_slots: int = 1, linger_s: float = 0.25,
-                 max_in_flight: int = 2, rng=None):
+                 max_in_flight: int = 2, rng=None,
+                 default_deadline_s: float | None = None):
         from ..crypto.bls.xla.dispatch import SlotDispatcher
 
         self._acc = MegabatchAccumulator(max_slots=max_slots,
@@ -87,6 +100,11 @@ class StreamScheduler:
         self._verdicts: dict[int, object] = {}
         self._inflight: deque = deque()   # (ticket, Megabatch)
         self._closed = False
+        # None = deadlines off (fail-safe default: a first fused-graph
+        # compile can take minutes and must not shed real work); the
+        # node wires a slot-tick value via PRYSM_TPU_SLOT_DEADLINE_S
+        self.default_deadline_s = default_deadline_s
+        self._t_submit: dict[int, float] = {}   # admitted-work latency
 
     # --- knobs --------------------------------------------------------------
 
@@ -96,16 +114,27 @@ class StreamScheduler:
 
     def set_depth(self, n: int) -> None:
         """Retarget the occupancy knob (N): callers raise it entering
-        a sync/replay span and drop it back to 1 at head-of-chain."""
+        a sync/replay span and drop it back to 1 at head-of-chain (the
+        auto-tuner ticks this too).  Resize and the over-limit check
+        happen under ONE lock hold: shrinking the depth below the
+        current accumulation flushes immediately, so a racing submit
+        can never observe a partial megabatch sized by the stale
+        ``max_slots``."""
         with self._lock:
             self._acc.max_slots = max(1, int(n))
+            if len(self._acc) >= self._acc.max_slots:
+                self._flush(FLUSH_FULL)
 
     # --- producer side ------------------------------------------------------
 
-    def submit(self, batch) -> int:
+    def submit(self, batch, deadline: float | None = None) -> int:
         """Queue one slot's ``IndexedSlotBatch``; returns the handle to
         pass to ``result``.  An empty batch verifies trivially True.
-        May dispatch (occupancy/table-switch flush) before returning."""
+        ``deadline`` is an absolute ``time.monotonic()`` instant
+        (defaulted from ``default_deadline_s`` when set); an already-
+        expired deadline sheds immediately — verdict False,
+        ``shed_deadline_exceeded``, zero device work.  May dispatch
+        (occupancy/table-switch flush) before returning."""
         with self._lock, _tracing.span("sched.submit"):
             if self._closed:
                 raise RuntimeError("scheduler is closed")
@@ -114,8 +143,15 @@ class StreamScheduler:
             if len(batch) == 0:
                 self._verdicts[handle] = True
                 return handle
+            if deadline is None and self.default_deadline_s is not None:
+                deadline = time.monotonic() + self.default_deadline_s
+            if deadline is not None and time.monotonic() >= deadline:
+                self._settle_shed([(handle, batch)])
+                return handle
+            self._t_submit[handle] = time.monotonic()
             limit = 1 if _breaker().is_open() else None
-            for mb in self._acc.add(handle, batch, max_slots=limit):
+            for mb in self._acc.add(handle, batch, max_slots=limit,
+                                    deadline=deadline):
                 self._dispatch(mb)
             return handle
 
@@ -137,6 +173,12 @@ class StreamScheduler:
             self._dispatch(mb)
 
     def _dispatch(self, mb) -> None:
+        if mb.shed:
+            # expired while queued: settled fail-closed BEFORE any
+            # device cost, never counted as a dispatch
+            self._settle_shed(mb.shed)
+        if not mb.entries:
+            return
         with _tracing.span("sched.flush", slots=len(mb),
                            reason=mb.reason):
             if _breaker().is_open():
@@ -147,10 +189,21 @@ class StreamScheduler:
                 _metrics().inc("megabatch_demotions")
                 self._settle_by_slot(mb)
                 return
-            _metrics().inc("megabatch_dispatches")
+            from ..crypto.bls.xla.dispatch import DeadlineRefused
+
             joined = mb.joined
             rng = self._rng
-            ticket = self._disp.submit(lambda: joined.verify_async(rng))
+            try:
+                ticket = self._disp.submit(
+                    lambda: joined.verify_async(rng),
+                    deadline=mb.deadline)
+            except DeadlineRefused:
+                # the dispatcher's device-compute p90 says this ticket
+                # cannot land in time — shed the whole megabatch now
+                # rather than burn device time on a doomed verdict
+                self._settle_shed(list(mb.entries))
+                return
+            _metrics().inc("megabatch_dispatches")
             self._inflight.append((ticket, mb))
 
     # --- consumer side ------------------------------------------------------
@@ -175,17 +228,43 @@ class StreamScheduler:
             raise v
         return bool(v)
 
-    def verify_now(self, batch) -> bool:
+    def verify_now(self, batch, deadline: float | None = None) -> bool:
         """Submit + claim in one call — the synchronous entry the
         per-slot services use.  At N=1 this is the passthrough path:
         one fused dispatch, verdict semantics identical to
         ``IndexedSlotBatch.verify``."""
-        return self.result(self.submit(batch))
+        return self.result(self.submit(batch, deadline=deadline))
 
     def pending(self) -> int:
         with self._lock:
             return len(self._acc) + sum(
                 len(mb) for _t, mb in self._inflight)
+
+    # --- verdict settling ---------------------------------------------------
+
+    def _record(self, handle: int, verdict) -> None:
+        """Set a REAL verdict (device/bisect/ladder result) and observe
+        the admitted-work submit→verdict latency; shed/close paths
+        bypass this so the latency histogram only ever describes work
+        the node actually served."""
+        t0 = self._t_submit.pop(handle, None)
+        if t0 is not None and not isinstance(verdict, BaseException):
+            _metrics().observe("admitted_verdict_latency_seconds",
+                               time.monotonic() - t0)
+        self._verdicts[handle] = verdict
+
+    def _settle_shed(self, shed) -> None:
+        """Fail-closed-with-reason for deadline-expired entries: an
+        explicit False verdict + ``shed_deadline_exceeded`` — NEVER a
+        silent drop, and never ``fail_closed_abandons`` (that counter
+        means lost work, not late work the node chose to drop)."""
+        from ..monitoring import flight as _flight
+
+        for h, _b in shed:
+            self._t_submit.pop(h, None)
+            self._verdicts[h] = False
+        _metrics().inc("shed_deadline_exceeded", len(shed))
+        _flight.note("deadline_shed", slots=len(shed))
 
     # --- drain / degradation ------------------------------------------------
 
@@ -221,14 +300,14 @@ class StreamScheduler:
             if ok:
                 _breaker().record_success()
                 for h, _b in mb.entries:
-                    self._verdicts[h] = True
+                    self._record(h, True)
             elif len(mb.joined) == 1:
                 # a clean single-attestation False is already fully
                 # isolated — a VERDICT, not a fault: the consumer's
                 # own per-attestation recovery takes over (identical
                 # to the fused per-slot path's semantics)
                 _breaker().record_success()
-                self._verdicts[mb.entries[0][0]] = False
+                self._record(mb.entries[0][0], False)
             else:
                 # the RLC check rejected the megabatch cleanly: some
                 # attestation aboard is poisoned — bisect ON-DEVICE to
@@ -266,7 +345,7 @@ class StreamScheduler:
             sub = list(entry_verdicts[pos:pos + len(b)])
             pos += len(b)
             b.fallback_verdicts = sub
-            self._verdicts[h] = all(sub)
+            self._record(h, all(sub))
 
     def _settle_by_slot(self, mb, bisected: bool = False) -> None:
         """Re-verify each constituent slot batch through its OWN PR-2
@@ -278,9 +357,9 @@ class StreamScheduler:
             _metrics().inc("megabatch_bisects")
         for h, b in mb.entries:
             try:
-                self._verdicts[h] = b.verify(self._rng)
+                self._record(h, b.verify(self._rng))
             except Exception as e:   # noqa: BLE001 — re-raised at claim
-                self._verdicts[h] = e
+                self._record(h, e)
 
     def _observe_amortized(self, mb) -> None:
         _metrics().observe(
@@ -303,17 +382,24 @@ class StreamScheduler:
             m = _metrics()
             mb = self._acc.flush(FLUSH_CLOSE)
             if mb is not None:
+                # shed-before-abandon: deadline-expired entries keep
+                # their honest reason counter even at shutdown
+                if mb.shed:
+                    self._settle_shed(mb.shed)
                 for h, _b in mb.entries:
+                    self._t_submit.pop(h, None)
                     self._verdicts[h] = False
-                m.inc("fail_closed_abandons", len(mb.entries))
-                from ..monitoring import flight as _flight
+                if mb.entries:
+                    m.inc("fail_closed_abandons", len(mb.entries))
+                    from ..monitoring import flight as _flight
 
-                _flight.note("scheduler_close_abandon",
-                             slots=len(mb.entries))
-                _flight.dump("fail_closed_abandon")
+                    _flight.note("scheduler_close_abandon",
+                                 slots=len(mb.entries))
+                    _flight.dump("fail_closed_abandon")
             inflight_slots = 0
             for _ticket, inflight_mb in self._inflight:
                 for h, _b in inflight_mb.entries:
+                    self._t_submit.pop(h, None)
                     self._verdicts[h] = False
                 inflight_slots += len(inflight_mb.entries)
             self._inflight.clear()

@@ -70,19 +70,21 @@ class SyncPeerScorer:
 
 
 def _stream_signatures_valid(chain, work, blocks):
-    """Whole-span verify through the chain's streaming scheduler at
-    sync depth: one handle per block, up to SYNC_STREAM_DEPTH blocks'
-    signature sets joined into one megabatch ticket, so the host-side
-    transition of block k+1 overlaps device verify of the megabatch
-    holding block k.  Returns True/False, or None to fall back to the
-    host-object span path on a transient device fault during
-    collection."""
+    """Whole-span verify through the chain's streaming scheduler: one
+    handle per block, the megabatch depth auto-tuned up to
+    SYNC_STREAM_DEPTH from the observed backlog (a backlogged span
+    doubles toward deep megabatch tickets; a trickle stays shallow
+    instead of lingering), so the host-side transition of block k+1
+    overlaps device verify of the megabatch holding block k.  Returns
+    True/False, or None to fall back to the host-object span path on
+    a transient device fault during collection."""
     from ..core.transition import collect_block_signature_batch_indexed
     from ..runtime import faults as _faults
+    from ..sched.autotune import DepthAutoTuner
 
     sched = chain.scheduler
     prev_depth = sched.max_slots
-    sched.set_depth(max(prev_depth, SYNC_STREAM_DEPTH))
+    tuner = DepthAutoTuner(sched, max_depth=SYNC_STREAM_DEPTH)
     handles = []
     bad = False
     degraded = False
@@ -94,6 +96,7 @@ def _stream_signatures_valid(chain, work, blocks):
                 b = collect_block_signature_batch_indexed(
                     work, blk, chain.pubkey_table)
                 handles.append(sched.submit(b))
+                tuner.tick()
                 state_transition(work, blk, chain.types,
                                  verify_signatures=False)
             except (StateTransitionError, ValueError):

@@ -14,6 +14,9 @@ reference's gRPC validator binary.
 
 from __future__ import annotations
 
+import random
+import time
+
 from ..config import beacon_config
 from ..core.helpers import (
     compute_epoch_at_slot, compute_signing_root,
@@ -25,11 +28,17 @@ from ..proto import Attestation
 from .keymanager import KeyManager
 from .protection import ProtectionError, SlashingProtectionDB
 
+#: gRPC RESOURCE_EXHAUSTED — how both RPC carriers surface an
+#: admission rejection (duck-typed off ``e.code`` so the runner stays
+#: transport-agnostic)
+_RESOURCE_EXHAUSTED = 8
+
 
 class ValidatorClient:
     def __init__(self, api, keymanager: KeyManager,
                  protection: SlashingProtectionDB | None = None,
-                 types=None):
+                 types=None, submit_retries: int = 3,
+                 submit_deadline_s: float = 4.0, rng=None):
         self.api = api
         self.km = keymanager
         self.protection = protection or SlashingProtectionDB()
@@ -43,6 +52,56 @@ class ValidatorClient:
         self.attested = 0
         self.aggregated = 0
         self.protection_refusals = 0
+        # bounded submission retry (admission rejections only)
+        self.submit_retries = int(submit_retries)
+        self.submit_deadline_s = float(submit_deadline_s)
+        self._rng = rng or random.Random(0xC0FFEE)
+        self.submit_retries_used = 0
+        self.submits_dropped = 0
+
+    # --- submission retry --------------------------------------------------
+
+    def _retry_after(self, e: Exception) -> float | None:
+        """Retry hint when ``e`` is an EXPLICIT admission rejection
+        (the server did NOT process the submission, so a resend is
+        safe); None for everything else — a timeout or transport error
+        on a mutating call may mean the first attempt landed, and
+        resending it would double-submit (mirrors
+        ``ValidatorRpcClient._IDEMPOTENT``)."""
+        from ..runtime.admission import AdmissionRejected, retry_after_from
+
+        if isinstance(e, AdmissionRejected):
+            return e.retry_after_s
+        if getattr(e, "code", None) == _RESOURCE_EXHAUSTED:
+            hinted = retry_after_from(str(e))
+            return hinted if hinted is not None else 0.1
+        return None
+
+    def _submit(self, fn, *args):
+        """Run one submission RPC with bounded, jittered retry on
+        admission rejections, honoring the server's RETRY_AFTER hint,
+        under an overall per-submission deadline."""
+        deadline = time.monotonic() + self.submit_deadline_s
+        attempt = 0
+        while True:
+            try:
+                return fn(*args)
+            except Exception as e:   # noqa: BLE001 — filtered below
+                retry_after = self._retry_after(e)
+                if retry_after is None:
+                    raise
+                attempt += 1
+                remaining = deadline - time.monotonic()
+                if attempt > self.submit_retries or retry_after >= remaining:
+                    # retry budget spent, or the hint itself overruns
+                    # the submission deadline: give up loudly
+                    self.submits_dropped += 1
+                    raise
+                # full hint + decorrelated jitter, capped by what's
+                # left of the deadline
+                delay = retry_after * (1.0 + self._rng.random())
+                time.sleep(max(0.0, min(delay, remaining)))
+                self.submit_retries_used += 1
 
     # --- duty loop ---------------------------------------------------------
 
@@ -85,7 +144,7 @@ class ValidatorClient:
         sig = self.km.sign(duty.pubkey, root)
         signed = self.types.SignedBeaconBlock(
             message=block, signature=sig.to_bytes())
-        block_root = self.api.submit_block(signed)
+        block_root = self._submit(self.api.submit_block, signed)
         self.proposed += 1
         return block_root
 
@@ -107,7 +166,7 @@ class ValidatorClient:
         bits = [v == duty.validator_index for v in duty.committee]
         att = Attestation(aggregation_bits=bits, data=data,
                           signature=sig.to_bytes())
-        self.api.submit_attestation(att)
+        self._submit(self.api.submit_attestation, att)
         self.attested += 1
         return att
 
@@ -146,6 +205,6 @@ class ValidatorClient:
         signed = SignedAggregateAndProof(
             message=message,
             signature=self.km.sign(duty.pubkey, root).to_bytes())
-        self.api.submit_aggregate_and_proof(signed)
+        self._submit(self.api.submit_aggregate_and_proof, signed)
         self.aggregated += 1
         return signed
