@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow lint chaos stream soak overload trace warm-cache dryrun bench native proto race
+.PHONY: test test-slow lint chaos stream soak overload multitenant trace warm-cache dryrun bench native proto race
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -64,6 +64,15 @@ soak:
 overload:
 	$(PY) -m pytest tests/test_overload.py -q -m "soak or not soak" -x
 	PRYSM_TIER_BUDGET=900 $(PY) bench.py --tier overload
+
+# Aggregation-engine gate (ISSUE 13): coalescing parity (device OR +
+# G2 aggregate vs the pure golden), feeder maturity policy, session
+# fairness, then the 10k-session / 500k-validator multi-tenant storm
+# tier — ledger balanced, zero divergence, zero fail-closed abandons,
+# chaos window live.
+multitenant:
+	$(PY) -m pytest tests/test_aggregation.py -q -m "slow or not slow" -x
+	PRYSM_TIER_BUDGET=900 $(PY) bench.py --tier multitenant
 
 # Observability artifact (ISSUE 11): a short traced soak with the
 # flight recorder armed — writes TRACE_SOAK.json (load at

@@ -640,6 +640,53 @@ def bench_overload():
     }
 
 
+def bench_multitenant():
+    """Multi-tenant tier: 10k registered client sessions (bound to
+    validator rows of a 500k-row pubkey table) submitting through the
+    session registry over the admission fairness credits into one
+    shared streaming scheduler, with a device-fault chaos window live
+    mid-storm — ``runtime/scenarios.run_multitenant``.  The metric of
+    merit is the admitted-work p99 latency under full tenancy; the
+    ledger (rejections + sheds + verdicts == submissions) and the
+    zero-abandon close are the acceptance gates."""
+    from prysm_tpu.config import set_features, use_minimal_config
+
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    from prysm_tpu.runtime.scenarios import run_multitenant
+
+    tier_budget = float(os.environ.get("PRYSM_TIER_BUDGET", "0"))
+    deadline_s = tier_budget * 0.8 if tier_budget > 0 else None
+    report = run_multitenant(n_sessions=10_000, n_validators=500_000,
+                             seed=1337, deadline_budget_s=deadline_s)
+    assert report["sessions"] >= 10_000, report["sessions"]
+    assert report["sessions_submitting"] >= 10_000, \
+        report["sessions_submitting"]
+    assert report["table_rows"] == 500_000, report["table_rows"]
+    assert report["chaos"], report
+    assert report["accounting_ok"], report
+    assert not report["divergences"], report["divergences"]
+    assert report["fail_closed_abandons"] == 0, report
+    # the credits throttle the hog, not the crowd
+    fair = report["fairness"]
+    assert fair["polite_accept_rate"] >= fair["hog_accept_rate"], fair
+    return {
+        "metric": "multitenant_p99_latency_ms",
+        "value": round(report["loaded_p99_s"] * 1e3, 3),
+        "unit": (f"ms admitted-work p99 "
+                 f"({report['sessions_submitting']} sessions, "
+                 f"{report['table_rows']} validators, "
+                 f"{report['submissions']} submissions"
+                 f"{', PARTIAL' if report['partial'] else ''}: "
+                 f"{report['rejections']} rejected, "
+                 f"{report['sheds']} shed, "
+                 f"{report['verdicts']} verdicts; hog accept "
+                 f"{fair['hog_accept_rate']}, polite "
+                 f"{fair['polite_accept_rate']})"),
+        "vs_baseline": 0.0,
+    }
+
+
 TIERS = [
     # (name, fn, wall budget seconds — generous for first compiles;
     # the persistent cache makes reruns fast)
@@ -656,6 +703,7 @@ TIERS = [
     ("field_throughput", bench_field_throughput, 300),
     ("soak", bench_soak, 900),
     ("overload", bench_overload, 900),
+    ("multitenant", bench_multitenant, 900),
 ]
 
 # the five BASELINE.json configs (plus companions) recorded every
@@ -664,7 +712,7 @@ TIERS = [
 FULL_TIERS = ("single_verify", "aggregate_verify", "slot_verify",
               "slot_throughput", "slot_pipeline", "stream_verify",
               "htr_registry", "htr_state_warm", "epoch_replay",
-              "epoch_replay_16k", "soak", "overload")
+              "epoch_replay_16k", "soak", "overload", "multitenant")
 
 
 # --- harness self-test hooks (tests/test_bench_harness.py) ------------------

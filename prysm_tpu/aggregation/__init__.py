@@ -1,0 +1,14 @@
+"""The aggregation engine: device-resident bitfield coalescing, the
+opportunistic megabatch feeder, and the multi-tenant session front end
+(ISSUE 13).  Sits between pool ingress and the streaming scheduler."""
+
+from .engine import CoalesceEngine
+from .feeder import OpportunisticFeeder
+from .sessions import ClientSession, SessionRegistry
+
+__all__ = [
+    "CoalesceEngine",
+    "OpportunisticFeeder",
+    "ClientSession",
+    "SessionRegistry",
+]

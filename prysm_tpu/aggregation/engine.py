@@ -1,0 +1,280 @@
+"""Device-resident attestation coalescing.
+
+The reference's background aggregator merges each (slot, committee,
+root) group with per-pair host BLS math — ``Signature.from_bytes`` +
+``Signature.aggregate`` once per single, O(groups · singles) pairings
+on the Python heap [U, SURVEY.md §3.3].  This engine keeps the exact
+greedy-merge SEMANTICS of that loop but executes the whole pool's
+point math as ONE bucket-padded device dispatch
+(``crypto/bls/xla/aggregate.g2_coalesce_device``): batched G2
+decompression + subgroup checks, a masked segment-sum per output
+aggregate, packed-uint32 bitfield OR, and canonical recompression —
+bit-identical to the pure golden model (enforced by
+``tests/test_aggregation.py``).
+
+Planning stays on the host (the greedy scan is inherently sequential
+and costs microseconds); only the field arithmetic rides the device.
+The planner replicates the pure loop decision-for-decision: a single
+whose bits are a subset of any current aggregate drops; a malformed
+single drops; a merge lands in the FIRST non-overlapping,
+parseable aggregate in list order; an unmergeable single is appended
+and becomes a merge candidate for later singles.  Malformed-signature
+knowledge comes from the device's own validity mask, so the device
+path needs at most two dispatches (plan optimistically, learn the bad
+set, re-plan) and usually one.
+
+Demotion: with the pure backend selected or the fused circuit breaker
+open, the SAME plans execute through iterated ``Signature.aggregate``
+(``agg_pure_fallbacks``) — verdict-identical, just slower.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..config import features
+from ..crypto.bls import bls
+from ..monitoring import tracing as _tracing
+from ..proto import Attestation
+from ..operations.attestations import (
+    _bits_subset, bits_overlap, merge_bits,
+)
+
+
+@dataclass(eq=False)
+class _Plan:
+    """One output aggregate: an existing aggregate (or first single)
+    plus the singles greedily merged into it."""
+
+    base: Attestation
+    is_new: bool                       # base is a pending single
+    bits: list = field(default_factory=list)   # running merged bits
+    members: list = field(default_factory=list)
+    frozen: bool = False               # base signature known-malformed
+
+    def atts(self) -> list:
+        return [self.base] + self.members
+
+
+def plan_merges(aggregated: list, pending: list, bad: set):
+    """The greedy non-overlap partitioner — the pure loop's decision
+    sequence without its point math.  ``bad`` holds ``id()``s of
+    attestations whose signatures are known-malformed (drop singles,
+    freeze aggregates).  Returns ``(plans, n_subset, n_malformed)``."""
+    plans = [
+        _Plan(base=a, is_new=False, bits=list(a.aggregation_bits),
+              frozen=id(a) in bad)
+        for a in aggregated
+    ]
+    n_subset = n_malformed = 0
+    for att in pending:
+        if any(_bits_subset(att.aggregation_bits, p.bits)
+               for p in plans):
+            n_subset += 1
+            continue
+        if id(att) in bad:
+            n_malformed += 1
+            continue
+        for p in plans:
+            if p.frozen or bits_overlap(att.aggregation_bits, p.bits):
+                continue
+            p.members.append(att)
+            p.bits = merge_bits(p.bits, att.aggregation_bits)
+            break
+        else:
+            plans.append(_Plan(base=att, is_new=True,
+                               bits=list(att.aggregation_bits)))
+    return plans, n_subset, n_malformed
+
+
+def _uniform_lengths(plan: _Plan) -> bool:
+    n = len(plan.base.aggregation_bits)
+    return all(len(m.aggregation_bits) == n for m in plan.members)
+
+
+class CoalesceEngine:
+    """Coalesce every group's pending singles in one device dispatch.
+
+    ``coalesce(snapshots)`` takes ``{group_key: (pending, aggregated)}``
+    captured under the pool lock and returns ``{group_key: new_aggs}``
+    — computed entirely WITHOUT the lock (the ISSUE-13 ingress-stall
+    fix); the pool merges the result back under the lock."""
+
+    def __init__(self):
+        self.last: dict = {}
+
+    # --- flight-recorder provider ------------------------------------------
+
+    def snapshot(self) -> dict:
+        return dict(self.last)
+
+    def register_flight(self) -> None:
+        from ..monitoring import flight as _flight
+
+        _flight.register_provider("coalesce_engine", self.snapshot)
+
+    # --- entry ---------------------------------------------------------------
+
+    def coalesce(self, snapshots: dict) -> dict:
+        from ..monitoring.metrics import metrics as _m
+
+        if not snapshots:
+            return {}
+        t0 = time.perf_counter()
+        n_pending = sum(len(p) for p, _ in snapshots.values())
+        with _tracing.span("agg.coalesce", groups=len(snapshots),
+                           pending=n_pending):
+            device = (features().bls_implementation in ("xla", "pallas")
+                      and not bls.fused_breaker.is_open())
+            if device:
+                try:
+                    out, stats = self._coalesce_device(snapshots)
+                except Exception as fault:  # noqa: BLE001 — classified
+                    from ..runtime import faults as _faults
+
+                    if not _faults.is_transient(fault):
+                        raise
+                    _m.inc("agg_pure_fallbacks")
+                    out, stats = self._coalesce_pure(snapshots)
+            else:
+                if features().bls_implementation in ("xla", "pallas"):
+                    # breaker open: demote this round to host math
+                    _m.inc("agg_pure_fallbacks")
+                out, stats = self._coalesce_pure(snapshots)
+        dt = time.perf_counter() - t0
+        _m.observe("stage_coalesce_seconds", dt)
+        _m.inc("agg_groups_coalesced", stats["agg_groups_coalesced"])
+        _m.inc("agg_singles_merged", stats["agg_singles_merged"])
+        _m.inc("agg_malformed_dropped", stats["agg_malformed_dropped"])
+        _m.inc("agg_subset_dropped", stats["agg_subset_dropped"])
+        self.last = {"groups": len(snapshots), "pending": n_pending,
+                     "device": device, "seconds": dt, **stats}
+        return out
+
+    # --- pure path -----------------------------------------------------------
+
+    def _coalesce_pure(self, snapshots: dict) -> tuple:
+        """Same plans, host point math — iterated pairwise
+        ``Signature.aggregate`` in merge order, exactly the old
+        in-lock loop's fold."""
+        stats = {"agg_groups_coalesced": 0, "agg_singles_merged": 0,
+                 "agg_malformed_dropped": 0, "agg_subset_dropped": 0}
+        out = {}
+        for key, (pending, aggregated) in snapshots.items():
+            bad, sigs = set(), {}
+            for att in list(pending) + list(aggregated):
+                try:
+                    sigs[id(att)] = bls.Signature.from_bytes(
+                        att.signature)
+                except ValueError:
+                    bad.add(id(att))
+            plans, n_sub, n_mal = plan_merges(aggregated, pending, bad)
+            stats["agg_subset_dropped"] += n_sub
+            stats["agg_malformed_dropped"] += n_mal
+            new_aggs = []
+            for p in plans:
+                if not p.members:
+                    new_aggs.append(p.base)
+                    continue
+                acc = sigs[id(p.base)]
+                for m in p.members:
+                    acc = bls.Signature.aggregate([acc, sigs[id(m)]])
+                new_aggs.append(Attestation(
+                    aggregation_bits=list(p.bits),
+                    data=p.base.data,
+                    signature=acc.to_bytes()))
+                stats["agg_groups_coalesced"] += 1
+                stats["agg_singles_merged"] += len(p.members)
+            out[key] = new_aggs
+        return out, stats
+
+    # --- device path ---------------------------------------------------------
+
+    def _coalesce_device(self, snapshots: dict) -> tuple:
+        """Plan optimistically, dispatch once, learn the malformed set
+        from the device validity mask, re-plan + re-dispatch only if
+        something was malformed."""
+        from ..crypto.bls.xla.aggregate import (
+            g2_coalesce_batch, pack_bits_u32, unpack_bits_u32,
+        )
+        from ..monitoring.metrics import metrics as _m
+        from ..runtime import faults as _faults
+
+        stats = {"agg_groups_coalesced": 0, "agg_singles_merged": 0,
+                 "agg_malformed_dropped": 0, "agg_subset_dropped": 0}
+
+        # one global point batch: every pending single AND every
+        # aggregate (validity of ALL of them falls out of pass 1, so a
+        # re-plan never needs a host parse)
+        atts, index_of = [], {}
+        for pending, aggregated in snapshots.values():
+            for att in list(aggregated) + list(pending):
+                index_of[id(att)] = len(atts)
+                atts.append(att)
+        sig_bytes = [bytes(a.signature) for a in atts]
+        bit_words = [pack_bits_u32(a.aggregation_bits) for a in atts]
+
+        bad: set = set()
+        for _pass in (1, 2):
+            per_group, jobs, pure_jobs = {}, [], []
+            n_sub = n_mal = 0
+            for key, (pending, aggregated) in snapshots.items():
+                plans, s, m = plan_merges(aggregated, pending, bad)
+                per_group[key] = plans
+                n_sub += s
+                n_mal += m
+                for p in plans:
+                    if not p.members:
+                        continue
+                    if _uniform_lengths(p):
+                        jobs.append(p)
+                    else:
+                        # ragged bitfield lengths inside one plan (zip-
+                        # truncating merge semantics) — host math keeps
+                        # byte-exact parity for this corner
+                        pure_jobs.append(p)
+            groups = ([[index_of[id(a)] for a in p.atts()]
+                       for p in jobs] or [[0]])
+            _faults.fire("device_dispatch")
+            _m.inc("agg_coalesce_dispatches")
+            agg_bytes, agg_words, ok = g2_coalesce_batch(
+                sig_bytes, bit_words, groups)
+            new_bad = {id(atts[i]) for i in range(len(atts))
+                       if not ok[i]}
+            if new_bad - bad:
+                bad |= new_bad
+                continue   # re-plan with full malformed knowledge
+            out = {}
+            for key, plans in per_group.items():
+                new_aggs = []
+                for p in plans:
+                    if not p.members:
+                        new_aggs.append(p.base)
+                        continue
+                    if p in pure_jobs:
+                        new_aggs.append(self._merge_pure(p))
+                    else:
+                        j = jobs.index(p)
+                        new_aggs.append(Attestation(
+                            aggregation_bits=unpack_bits_u32(
+                                agg_words[j], len(p.bits)),
+                            data=p.base.data,
+                            signature=agg_bytes[j]))
+                    stats["agg_groups_coalesced"] += 1
+                    stats["agg_singles_merged"] += len(p.members)
+                out[key] = new_aggs
+            stats["agg_subset_dropped"] = n_sub
+            stats["agg_malformed_dropped"] = n_mal
+            return out, stats
+        raise AssertionError("unreachable: pass 2 is parse-complete")
+
+    @staticmethod
+    def _merge_pure(p: _Plan) -> Attestation:
+        acc = bls.Signature.from_bytes(p.base.signature)
+        for m in p.members:
+            acc = bls.Signature.aggregate(
+                [acc, bls.Signature.from_bytes(m.signature)])
+        return Attestation(aggregation_bits=list(p.bits),
+                           data=p.base.data,
+                           signature=acc.to_bytes())

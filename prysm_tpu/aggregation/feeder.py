@@ -1,0 +1,180 @@
+"""Opportunistic megabatch feeding.
+
+The tick-driven pipeline aggregates and verifies once per slot tick —
+an attestation arriving right after the tick waits a whole slot before
+its group even coalesces, and the scheduler's megabatch accumulates
+nothing in between.  The feeder watches ingress (``pool.save_*`` call
+``notify`` after releasing the pool lock) and submits matured slot
+batches into ``StreamScheduler.submit`` AS AGGREGATES LAND, so device
+work streams instead of bursting at tick edges.
+
+Maturity policy — any of:
+
+* **coverage quorum**: the group's OR'd aggregation bits cover at
+  least ``quorum`` of the committee (feeding earlier would verify an
+  aggregate a later single would immediately supersede);
+* **linger bound**: the group's oldest attestation has waited
+  ``linger_s`` (thin traffic must not wait for a quorum that never
+  comes) — swept by ``tick()`` from the node's slot loop;
+* **deadline pressure**: the scheduler carries a default slot deadline
+  (PR-12 plumbing) and the group's age has burned half of it — feed
+  now or risk the shed path.
+
+Verdicts are claimed by ``sync.verify_slot_batch`` via ``collect``:
+fed batches' verdicts are consumed through the same code path as the
+tick batch, and fed attestations are EXCLUDED from the tick build
+(``build_slot_batch_indexed(exclude=...)``) so nothing verifies twice.
+
+Demotion: an open fused breaker (or the pure backend) parks the
+feeder — the tick-driven path still covers every attestation, the
+stream just stops being opportunistic (``feeder_demotions``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..config import features
+from ..monitoring import tracing as _tracing
+from ..operations.attestations import _group_key, merge_bits
+
+
+class _GroupTrack:
+    __slots__ = ("first_seen", "bits")
+
+    def __init__(self, first_seen: float, bits: list):
+        self.first_seen = first_seen
+        self.bits = bits
+
+
+class OpportunisticFeeder:
+    def __init__(self, pool, scheduler, state_fn, quorum: float = 0.67,
+                 linger_s: float = 2.0, time_fn=time.monotonic):
+        self.pool = pool
+        self.scheduler = scheduler
+        self.state_fn = state_fn
+        self.quorum = quorum
+        self.linger_s = linger_s
+        self.time_fn = time_fn
+        self._lock = threading.Lock()
+        # (slot, index, root) -> _GroupTrack for not-yet-fed coverage
+        self._track: dict = {}
+        # slot -> set of id()s of attestation objects already fed
+        self._fed: dict = {}
+        # slot -> [(handle, batch)] awaiting collect()
+        self._inflight: dict = {}
+        self._feeding: set = set()   # slots with a feed in progress
+
+    # --- ingress hook (called OUTSIDE the pool lock) ------------------------
+
+    def notify(self, att) -> None:
+        """Track coverage; feed the slot when its group matures."""
+        if features().bls_implementation not in ("xla", "pallas"):
+            return
+        key = _group_key(att)
+        now = self.time_fn()
+        with self._lock:
+            t = self._track.get(key)
+            if t is None:
+                t = self._track[key] = _GroupTrack(
+                    now, list(att.aggregation_bits))
+            else:
+                t.bits = merge_bits(t.bits, att.aggregation_bits)
+            covered = sum(t.bits) >= self.quorum * max(len(t.bits), 1)
+        if covered:
+            self.feed(key[0])
+
+    # --- maturity sweep (called from the node's slot tick) ------------------
+
+    def tick(self, slot: int | None = None) -> None:
+        """Feed every slot holding a group past its linger bound or
+        under deadline pressure."""
+        now = self.time_fn()
+        bound = self.linger_s
+        deadline = getattr(self.scheduler, "default_deadline_s", None)
+        if deadline is not None:
+            bound = min(bound, 0.5 * deadline)
+        with self._lock:
+            due = {k[0] for k, t in self._track.items()
+                   if now - t.first_seen >= bound}
+        for s in sorted(due):
+            self.feed(s)
+
+    # --- the feed itself ----------------------------------------------------
+
+    def feed(self, slot: int) -> bool:
+        """Coalesce the pool and submit ``slot``'s not-yet-fed work to
+        the scheduler.  Returns True when a batch was submitted."""
+        from ..crypto.bls import bls as _bls
+        from ..monitoring.metrics import metrics as _m
+
+        if _bls.fused_breaker.is_open():
+            _m.inc("feeder_demotions")
+            return False
+        with self._lock:
+            if slot in self._feeding:
+                return False    # a concurrent feed already has it
+            self._feeding.add(slot)
+        try:
+            with _tracing.span("agg.feed", slot=slot):
+                self.pool.aggregate_unaggregated()
+                batch = self.pool.build_slot_batch_indexed(
+                    self.state_fn(), slot,
+                    exclude=self.fed_ids(slot))
+                if len(batch) == 0:
+                    return False
+                handle = self.scheduler.submit(batch)
+                _m.inc("feeder_submits")
+                with self._lock:
+                    fed = self._fed.setdefault(slot, set())
+                    fed.update(id(a) for a in batch.attestations)
+                    self._inflight.setdefault(slot, []).append(
+                        (handle, batch))
+                    for k in [k for k in self._track if k[0] == slot]:
+                        del self._track[k]
+                return True
+        finally:
+            with self._lock:
+                self._feeding.discard(slot)
+
+    # --- verdict handoff ----------------------------------------------------
+
+    def fed_ids(self, slot: int):
+        """ids of attestation objects already riding a fed batch for
+        ``slot`` — the tick build excludes them."""
+        with self._lock:
+            return frozenset(self._fed.get(slot, ()))
+
+    def collect(self, slot: int) -> list:
+        """Claim verdicts for every fed batch of ``slot``: a list of
+        ``(batch, ok)`` in submission order.  Blocks on still-inflight
+        work (demand-flushes the scheduler, same as verify_now)."""
+        with self._lock:
+            inflight = self._inflight.pop(slot, [])
+        return [(batch, self.scheduler.result(handle))
+                for handle, batch in inflight]
+
+    def prune_before(self, slot: int) -> None:
+        with self._lock:
+            for d in (self._fed, self._inflight):
+                for s in [s for s in d if s < slot]:
+                    del d[s]
+            for k in [k for k in self._track if k[0] < slot]:
+                del self._track[k]
+
+    # --- flight-recorder provider ------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "tracked_groups": len(self._track),
+                "fed_slots": {s: len(v) for s, v in self._fed.items()},
+                "inflight": {s: len(v)
+                             for s, v in self._inflight.items()},
+            }
+
+    def register_flight(self) -> None:
+        from ..monitoring import flight as _flight
+
+        _flight.register_provider("feeder", self.snapshot)

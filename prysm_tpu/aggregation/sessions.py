@@ -1,0 +1,121 @@
+"""Multi-tenant client sessions over the admission fairness credits.
+
+The PR-12 ``AdmissionController`` already meters per-client token
+buckets keyed by opaque ``client_id`` strings; what it lacks is a
+registry making those identities first-class — who registered, which
+validator indices they operate, what happened to their submissions.
+``SessionRegistry`` binds thousands of concurrent validator-client
+identities (the 10k-session multitenant tier) to those credits: every
+submission charges through ``admit()``, acceptance/rejection lands on
+the session's own ledger, and the whole registry state rides
+``/debug/flight`` black boxes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class ClientSession:
+    """One validator-client identity and its submission ledger."""
+
+    client_id: str
+    validators: tuple = ()
+    registered_at: float = 0.0
+    submitted: int = 0
+    accepted: int = 0
+    rejected: int = 0
+
+
+class SessionRegistry:
+    """Registry of concurrent client sessions sharing one admission
+    controller.  Thread-safe; built for 10k+ concurrent sessions, so
+    every hot-path operation is O(1) and ``snapshot()`` aggregates
+    instead of enumerating."""
+
+    def __init__(self, admission=None, time_fn=time.monotonic):
+        self.admission = admission
+        self.time_fn = time_fn
+        self._lock = threading.Lock()
+        self._sessions: dict[str, ClientSession] = {}
+
+    def register(self, client_id: str,
+                 validators=()) -> ClientSession:
+        from ..monitoring.metrics import metrics as _m
+
+        with self._lock:
+            sess = self._sessions.get(client_id)
+            if sess is None:
+                sess = ClientSession(client_id=client_id,
+                                     validators=tuple(validators),
+                                     registered_at=self.time_fn())
+                self._sessions[client_id] = sess
+                _m.inc("session_registrations")
+            return sess
+
+    def get(self, client_id: str) -> ClientSession | None:
+        with self._lock:
+            return self._sessions.get(client_id)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def admit(self, client_id: str, cost: float = 1.0) -> None:
+        """Charge one submission against the client's fairness
+        credits.  Raises ``AdmissionRejected`` (re-raised verbatim so
+        carriers keep their retry_after mapping) after recording the
+        rejection on the session ledger."""
+        from ..monitoring.metrics import metrics as _m
+        from ..runtime.admission import AdmissionRejected
+
+        sess = self.register(client_id)
+        with self._lock:
+            sess.submitted += 1
+        if self.admission is None:
+            with self._lock:
+                sess.accepted += 1
+            return
+        try:
+            self.admission.admit(client_id=client_id, cost=cost)
+        except AdmissionRejected:
+            with self._lock:
+                sess.rejected += 1
+            _m.inc("session_rejections")
+            raise
+        with self._lock:
+            sess.accepted += 1
+
+    # --- introspection ------------------------------------------------------
+
+    def accepted_by_client(self) -> dict:
+        """client_id -> accepted count (the fairness assertion's
+        input)."""
+        with self._lock:
+            return {c: s.accepted for c, s in self._sessions.items()}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        n = len(sessions)
+        tot_sub = sum(s.submitted for s in sessions)
+        tot_rej = sum(s.rejected for s in sessions)
+        top = max(sessions, key=lambda s: s.submitted, default=None)
+        return {
+            "sessions": n,
+            "submitted": tot_sub,
+            "accepted": sum(s.accepted for s in sessions),
+            "rejected": tot_rej,
+            "top_talker": None if top is None else
+                {"client_id": top.client_id,
+                 "submitted": top.submitted,
+                 "rejected": top.rejected},
+        }
+
+    def register_flight(self) -> None:
+        from ..monitoring import flight as _flight
+
+        _flight.register_provider("sessions", self.snapshot)

@@ -1,0 +1,185 @@
+"""Batched G2 signature coalescing on device (the aggregation engine's
+crypto core).
+
+Reference analog: the reference's background aggregator merges each
+(slot, committee, root) group's single-bit attestations into aggregates
+with per-pair host BLS point math (``Signature.aggregate``) under the
+pool lock [U, SURVEY.md §3.3].  Here the WHOLE pool coalesces in ONE
+bucket-padded device dispatch:
+
+* every signature decompresses + subgroup-checks in one batch
+  (``compress.g2_decompress_device`` — the same fail-closed graph the
+  verify path uses);
+* a (G, K) index/mask plan gathers each output group's member points
+  and a masked segment-sum (halving tree over the K axis) adds them —
+  point addition is associative, so one batched sum is bit-identical
+  to the pure loop's pairwise folds;
+* the group sums come back as canonical affine limbs + sign bits and
+  re-serialize on the host to EXACTLY the bytes
+  ``Signature.aggregate(...).to_bytes()`` would produce.
+
+The per-point ``ok`` mask is exactly "``Signature.from_bytes`` would
+not raise": the caller drops malformed singles and refuses to merge
+into malformed aggregates, re-planning like the pure loop's
+ValueError paths (aggregation/engine.py owns that policy).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import limbs as L
+from .compress import (
+    C_FLAG, I_FLAG, S_FLAG, _fq2_lex_gt_half, g2_decompress_device,
+    parse_g2_compressed,
+)
+from .curve import FQ2_OPS, g2_to_affine, point_sum_tree
+
+# Montgomery one in Fq2 — the coordinate filler for masked (padding)
+# gather rows, which enter the segment sum as Jacobian infinity
+_FQ2_ONE_MONT = np.zeros((2, L.NLIMBS), dtype=np.uint32)
+_FQ2_ONE_MONT[0] = L.ONE_MONT
+
+#: canonical compressed encoding of the G2 identity (infinity) point
+INF_G2 = bytes([C_FLAG | I_FLAG]) + b"\x00" * 95
+
+
+@jax.jit
+def g2_coalesce_device(sig_x, sig_inf, sig_sign, sig_wf, bits, rows,
+                       rmask):
+    """Decompress ``n`` compressed G2 signatures, sum them into ``G``
+    groups, and OR the groups' packed aggregation bitfields — ONE
+    dispatch for the whole pool.
+
+    Inputs: the ``parse_g2_compressed`` quadruple for the point batch
+    (x uint32 (n, 2, 24); inf/sign/wf bool (n,)), the packed-uint32
+    bitfields ``bits`` (n, W), and the gather plan — ``rows`` int32
+    (G, K) member indices and ``rmask`` bool (G, K) liveness (masked
+    entries add the identity / OR zero).
+
+    Returns ``(x_canon, sign, inf, obits, ok)``: per-group canonical
+    affine x limbs (G, 2, 24), the serialization sign bit, the
+    group-sum-is-infinity mask, the OR'd bitfield words (G, W), and
+    the per-POINT validity mask (``ok[i]`` false exactly when the pure
+    ``from_bytes`` would raise; such points enter sums as infinity —
+    callers re-plan)."""
+    jac, ok = g2_decompress_device(sig_x, sig_inf, sig_sign, sig_wf)
+    X, Y, Z = jac
+    one = jnp.asarray(_FQ2_ONE_MONT)
+    live = rmask[..., None, None]
+    gx = jnp.where(live, X[rows], one)
+    gy = jnp.where(live, Y[rows], one)
+    gz = jnp.where(live, Z[rows], jnp.zeros_like(one))
+    # segment-sum per group: K to the leading axis, halving-tree fold
+    pt = tuple(jnp.moveaxis(t, 1, 0) for t in (gx, gy, gz))
+    ax, ay, ainf = g2_to_affine(point_sum_tree(FQ2_OPS, pt))
+    x_canon = L.from_mont(ax)
+    sign = _fq2_lex_gt_half(L.from_mont(ay))
+    gb = jnp.where(rmask[..., None], bits[rows], jnp.uint32(0))
+    obits = jax.lax.reduce(gb, jnp.uint32(0), jax.lax.bitwise_or, (1,))
+    return x_canon, sign, ainf, obits, ok
+
+
+# --- host serialization (inverse of compress._bytes_to_limbs) --------------
+
+
+def _limbs_to_be48(limbs: np.ndarray) -> np.ndarray:
+    """(g, 24) little-endian 16-bit limbs -> (g, 48) big-endian bytes."""
+    le = np.empty((limbs.shape[0], 48), dtype=np.uint8)
+    le[:, 0::2] = (limbs & 0xFF).astype(np.uint8)
+    le[:, 1::2] = ((limbs >> 8) & 0xFF).astype(np.uint8)
+    return le[:, ::-1]
+
+
+def serialize_g2_compressed(x_limbs: np.ndarray, sign: np.ndarray,
+                            inf: np.ndarray) -> np.ndarray:
+    """Canonical affine x limbs (g, 2, 24) + sign/inf masks -> (g, 96)
+    ZCash-format compressed bytes, byte-identical to the pure
+    ``g2_to_bytes`` (c1-with-flags BE then c0 BE; canonical infinity
+    encoding for inf rows)."""
+    c0 = _limbs_to_be48(np.asarray(x_limbs[:, 0], dtype=np.uint32))
+    c1 = _limbs_to_be48(np.asarray(x_limbs[:, 1], dtype=np.uint32))
+    out = np.concatenate([c1, c0], axis=1)
+    out[:, 0] |= C_FLAG
+    out[:, 0] = np.where(np.asarray(sign, bool) & ~np.asarray(inf, bool),
+                         out[:, 0] | S_FLAG, out[:, 0])
+    inf_rows = np.asarray(inf, bool)
+    if inf_rows.any():
+        out[inf_rows] = np.frombuffer(INF_G2, dtype=np.uint8)
+    return out
+
+
+# --- batched host entry ----------------------------------------------------
+
+
+def pack_bits_u32(bits) -> np.ndarray:
+    """Bool bitfield -> packed little-bit-order uint32 words (1-D)."""
+    packed = np.packbits(np.asarray(bits, dtype=bool), bitorder="little")
+    pad = (-len(packed)) % 4
+    if pad:
+        packed = np.concatenate([packed, np.zeros(pad, np.uint8)])
+    return packed.view(np.uint32)
+
+
+def unpack_bits_u32(words: np.ndarray, nbits: int) -> list:
+    """Packed uint32 words -> bool list of the original length."""
+    raw = np.unpackbits(np.asarray(words, np.uint32).view(np.uint8),
+                        bitorder="little")
+    return [bool(v) for v in raw[:nbits]]
+
+
+def g2_coalesce_batch(sig_bytes: list, bit_words: list, groups: list):
+    """Coalesce compressed signatures + packed bitfields into group
+    aggregates in one bucket-padded device dispatch.
+
+    ``sig_bytes``: n 96-byte compressed signatures; ``bit_words``: the
+    matching packed-uint32 bitfields (ragged — padded to one bucketed
+    W axis here); ``groups``: lists of indices into them (a group's
+    members are point-summed and bit-OR'd).  Returns
+    ``(agg_bytes, agg_words, ok)``: one compressed 96-byte aggregate +
+    one OR'd word row per group (byte-identical to
+    ``Signature.aggregate`` over the same members when every member is
+    valid) and the per-signature validity mask.  Groups containing an
+    invalid member still come back (the bad point summed as identity)
+    — callers MUST check ``ok`` and re-plan, which mirrors the pure
+    loop's drop/skip-on-ValueError semantics."""
+    from ..bls import _bucket
+
+    n = len(sig_bytes)
+    data = np.frombuffer(
+        b"".join(bytes(s) for s in sig_bytes), dtype=np.uint8,
+    ).reshape(n, 96)
+    nb = _bucket(n)
+    if nb > n:
+        pad = np.frombuffer(INF_G2 * (nb - n), dtype=np.uint8)
+        data = np.concatenate([data, pad.reshape(nb - n, 96)])
+    x, inf, sign, wf = parse_g2_compressed(data)
+
+    wb = _bucket(max(len(w) for w in bit_words))
+    words = np.zeros((nb, wb), dtype=np.uint32)
+    for i, w in enumerate(bit_words):
+        words[i, :len(w)] = w
+
+    gb = _bucket(len(groups))
+    kb = _bucket(max(len(g) for g in groups))
+    rows = np.zeros((gb, kb), dtype=np.int32)
+    rmask = np.zeros((gb, kb), dtype=bool)
+    for i, g in enumerate(groups):
+        rows[i, :len(g)] = g
+        rmask[i, :len(g)] = True
+
+    x_canon, out_sign, out_inf, obits, ok = g2_coalesce_device(
+        jnp.asarray(x), jnp.asarray(inf), jnp.asarray(sign),
+        jnp.asarray(wf), jnp.asarray(words), jnp.asarray(rows),
+        jnp.asarray(rmask))
+    raw = serialize_g2_compressed(
+        np.asarray(x_canon)[:len(groups)],
+        np.asarray(out_sign)[:len(groups)],
+        np.asarray(out_inf)[:len(groups)])
+    agg_words = np.asarray(obits)[:len(groups)]
+    return ([raw[i].tobytes() for i in range(len(groups))],
+            [agg_words[i] for i in range(len(groups))],
+            np.asarray(ok)[:n])

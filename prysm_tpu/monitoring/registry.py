@@ -142,6 +142,37 @@ _BASE: dict[str, tuple[str, str]] = {
         COUNTER, "slots shed fail-closed because their deadline passed "
                  "before device dispatch (distinct from "
                  "fail_closed_abandons: late, not lost)"),
+    # --- aggregation engine: coalescing / feeder / sessions (PR 13)
+    "agg_coalesce_dispatches": (
+        COUNTER, "whole-pool coalescing device dispatches"),
+    "agg_groups_coalesced": (
+        COUNTER, "output aggregates that absorbed at least one single"),
+    "agg_malformed_dropped": (
+        COUNTER, "malformed-signature singles dropped by the planner"),
+    "agg_pure_fallbacks": (
+        COUNTER, "coalesce rounds demoted to host point math (open "
+                 "breaker or transient device fault)"),
+    "agg_singles_merged": (
+        COUNTER, "single-bit attestations merged into aggregates"),
+    "agg_subset_dropped": (
+        COUNTER, "already-covered singles dropped by subset dedup"),
+    "feeder_demotions": (
+        COUNTER, "opportunistic feeds skipped because the fused "
+                 "breaker is open (tick-driven path covers)"),
+    "feeder_submits": (
+        COUNTER, "matured slot batches streamed into the scheduler "
+                 "between ticks"),
+    "pk_obj_cache_evictions": (
+        COUNTER, "pure-backend pubkey object cache FIFO evictions"),
+    "session_registrations": (
+        COUNTER, "client sessions registered with the multi-tenant "
+                 "front end"),
+    "session_rejections": (
+        COUNTER, "session submissions refused by admission fairness "
+                 "credits"),
+    "stage_coalesce_seconds": (
+        HISTOGRAM, "whole-pool coalesce latency (plan + device "
+                   "dispatch + recompress)"),
     # --- node / services
     "block_processing_seconds": (
         HISTOGRAM, "per-block processing latency (blockchain service)"),
@@ -195,6 +226,12 @@ BENCH_STAMPED: tuple[str, ...] = (
     "admission_admits", "admission_rejections",
     "shed_deadline_exceeded", "dispatch_deadline_refusals",
     "depth_autotune_raise", "depth_autotune_lower",
+    "agg_coalesce_dispatches", "agg_groups_coalesced",
+    "agg_singles_merged", "agg_subset_dropped",
+    "agg_malformed_dropped", "agg_pure_fallbacks",
+    "feeder_submits", "feeder_demotions",
+    "session_registrations", "session_rejections",
+    "pk_obj_cache_evictions",
 )
 
 #: histograms bench.py stamps into each tier's JSON as p50/p90/p99
@@ -206,6 +243,7 @@ BENCH_STAMPED_QUANTILES: tuple[str, ...] = (
     "stage_demux_seconds", "megabatch_linger_seconds",
     "megabatch_amortized_slot_seconds", "slot_verify_latency_seconds",
     "admitted_verdict_latency_seconds", "megabatch_occupancy",
+    "stage_coalesce_seconds",
 )
 
 #: every declared span name (the slot-lifecycle trace taxonomy) ->
@@ -215,6 +253,8 @@ BENCH_STAMPED_QUANTILES: tuple[str, ...] = (
 #: a typo'd span silently traces nothing, a dead declaration is a lie
 #: in the taxonomy.
 SPANS: dict[str, str] = {
+    "agg.coalesce": "whole-pool device coalescing round",
+    "agg.feed": "opportunistic matured-batch feed into the scheduler",
     "chain.receive_block": "blockchain service whole-block path",
     "dispatch.device": "fused verify dispatch (async, un-read-back)",
     "dispatch.pack": "host packing of the fused dispatch args",

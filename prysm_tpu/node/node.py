@@ -91,6 +91,20 @@ class BeaconNode:
                 float(beacon_config().seconds_per_slot)
                 if deadline_env == "tick" else float(deadline_env))
 
+        # opportunistic aggregation feeder (aggregation/feeder.py):
+        # pool ingress notifies it after every save, matured groups
+        # stream into the scheduler between ticks; the slot tick
+        # sweeps linger-bound groups and sync claims the verdicts
+        from ..aggregation import OpportunisticFeeder
+
+        self.feeder = OpportunisticFeeder(
+            self.att_pool, self.chain.scheduler,
+            state_fn=lambda: self.chain.head_state,
+            linger_s=float(beacon_config().seconds_per_slot) / 4.0)
+        self.att_pool.feeder = self.feeder
+        self.feeder.register_flight()
+        self.att_pool._coalesce_engine().register_flight()
+
         self.peer = bus.join(node_id)
         self.sync = SyncService(self.peer, self.chain, self.att_pool,
                                 types=self.types, metrics=self.metrics)
@@ -160,6 +174,9 @@ class BeaconNode:
         # drain/linger drops it back toward 1
         self.autotuner.tick()
         self.sync.retry_pending()
+        # linger sweep: groups past their wait bound stream into the
+        # scheduler now rather than waiting for the build below
+        self.feeder.tick(slot)
         self.att_pool.aggregate_unaggregated()
         if slot >= 1:
             t0 = time.perf_counter()

@@ -36,7 +36,9 @@ def _group_key(att: Attestation) -> tuple[int, int, bytes]:
     return (att.data.slot, att.data.index, att.data.beacon_block_root)
 
 
-def _bits_overlap(a, b) -> bool:
+def bits_overlap(a, b) -> bool:
+    """Shared with aggregation/engine.py (the coalescing planner must
+    replicate these zip-truncating semantics exactly)."""
     return any(x and y for x, y in zip(a, b))
 
 
@@ -45,7 +47,7 @@ def _bits_subset(a, b) -> bool:
     return all((not x) or y for x, y in zip(a, b))
 
 
-def _merge_bits(a, b) -> list[bool]:
+def merge_bits(a, b) -> list[bool]:
     return [x or y for x, y in zip(a, b)]
 
 
@@ -74,6 +76,29 @@ class AttestationPool:
         # (gossip, sync replays); API submissions arrive context-
         # marked admitted, so they are never double-charged.
         self.admission = None
+        # opportunistic feeder (aggregation/feeder.py; the node wires
+        # it): notified AFTER the pool lock releases on every save so
+        # matured groups stream into the scheduler between ticks
+        self.feeder = None
+        # device coalescing engine (aggregation/engine.py) — lazy so a
+        # bare pool import stays light
+        self._engine = None
+
+    def _coalesce_engine(self):
+        if self._engine is None:
+            from ..aggregation.engine import CoalesceEngine
+
+            self._engine = CoalesceEngine()
+        return self._engine
+
+    def _notify_feeder(self, att) -> None:
+        """Ingress hook for the opportunistic feeder.  MUST be called
+        with the pool lock RELEASED: the feeder's feed path re-enters
+        the pool (aggregate + build), and holding the lock here would
+        re-create exactly the ingress stall this PR removes."""
+        f = self.feeder
+        if f is not None:
+            f.notify(att)
 
     # --- ingest ------------------------------------------------------------
 
@@ -92,6 +117,7 @@ class AttestationPool:
                    and att.data == e.data for e in g.unaggregated):
                 return
             g.unaggregated.append(att)
+        self._notify_feeder(att)
 
     def save_aggregated(self, att: Attestation) -> None:
         if sum(att.aggregation_bits) < 1:
@@ -108,6 +134,7 @@ class AttestationPool:
                 if not _bits_subset(e.aggregation_bits,
                                     att.aggregation_bits)]
             g.aggregated.append(att)
+        self._notify_feeder(att)
 
     def save_block_attestation(self, att: Attestation) -> None:
         with self._lock:
@@ -118,43 +145,48 @@ class AttestationPool:
     def aggregate_unaggregated(self) -> None:
         """Merge single-bit attestations into aggregates per group
         (greedy non-overlapping merge + BLS signature aggregation —
-        AggregateUnaggregatedAttestations analog)."""
+        AggregateUnaggregatedAttestations analog).
+
+        Three-phase to keep ingress unblocked (ISSUE 13): snapshot the
+        dirty groups under the lock, run the point math OUTSIDE it
+        (the coalescing engine — one batched device dispatch for the
+        whole pool, or the pure fold under the pure backend/open
+        breaker), then merge back under the lock with a subset-dedup
+        re-check against aggregates that arrived meanwhile.  The old
+        code held the pool RLock across per-pair pure BLS aggregation,
+        stalling every ``save_*`` behind O(singles) pairings."""
+        snapshots: dict = {}
+        snap_agg_ids: dict = {}
         with self._lock:
             for key, g in self._groups.items():
                 if not g.unaggregated:
                     continue
-                pending = list(g.unaggregated)
+                snapshots[key] = (list(g.unaggregated),
+                                  list(g.aggregated))
+                snap_agg_ids[key] = {id(a) for a in g.aggregated}
                 g.unaggregated = []
-                for att in pending:
+        if not snapshots:
+            return
+        results = self._coalesce_engine().coalesce(snapshots)
+        with self._lock:
+            for key, new_aggs in results.items():
+                g = self._groups[key]
+                # aggregates that landed while we were off-lock get
+                # the save_aggregated two-way subset fold against the
+                # coalesced output
+                arrivals = [a for a in g.aggregated
+                            if id(a) not in snap_agg_ids[key]]
+                merged: list[Attestation] = []
+                for att in new_aggs + arrivals:
                     if any(_bits_subset(att.aggregation_bits,
-                                        agg.aggregation_bits)
-                           for agg in g.aggregated):
-                        continue   # already covered: drop, don't dup
-                    try:
-                        att_sig = bls.Signature.from_bytes(att.signature)
-                    except ValueError:
-                        continue   # malformed single: drop
-                    merged = False
-                    for i, agg in enumerate(g.aggregated):
-                        if _bits_overlap(att.aggregation_bits,
-                                         agg.aggregation_bits):
-                            continue
-                        try:
-                            agg_sig = bls.Signature.from_bytes(
-                                agg.signature)
-                        except ValueError:
-                            continue   # don't merge into bad aggregate
-                        sig = bls.Signature.aggregate([agg_sig, att_sig])
-                        g.aggregated[i] = Attestation(
-                            aggregation_bits=_merge_bits(
-                                agg.aggregation_bits,
-                                att.aggregation_bits),
-                            data=agg.data,
-                            signature=sig.to_bytes())
-                        merged = True
-                        break
-                    if not merged:
-                        g.aggregated.append(att)
+                                        e.aggregation_bits)
+                           for e in merged):
+                        continue
+                    merged = [e for e in merged
+                              if not _bits_subset(e.aggregation_bits,
+                                                  att.aggregation_bits)]
+                    merged.append(att)
+                g.aggregated = merged
 
     # --- queries -----------------------------------------------------------
 
@@ -192,6 +224,9 @@ class AttestationPool:
             self.block_attestations = [
                 a for a in self.block_attestations
                 if a.data.slot >= slot]
+        f = self.feeder
+        if f is not None:
+            f.prune_before(slot)
 
     # --- north-star: whole-slot signature batch ----------------------------
 
@@ -218,8 +253,8 @@ class AttestationPool:
                 out.append((committee, att))
         return out
 
-    def build_slot_batch_indexed(self, state, slot: int
-                                 ) -> "IndexedSlotBatch":
+    def build_slot_batch_indexed(self, state, slot: int,
+                                 exclude=None) -> "IndexedSlotBatch":
         """Device-native slot batch (VERDICT r4 #4): signer sets as
         index rows into the registry pubkey table — NO pure-Python
         point math anywhere on this path.  ``verify()`` then runs
@@ -230,7 +265,11 @@ class AttestationPool:
         Signer extraction is batched numpy (boolean row selection),
         not a per-signature Python loop: at mainnet committee sizes
         the old list comprehensions were ~10^5 Python iterations per
-        slot on the latency path."""
+        slot on the latency path.
+
+        ``exclude``: ``id()``s of attestation objects to skip — the
+        opportunistic feeder's already-fed work, which has its own
+        in-flight batch and must not verify twice."""
         import numpy as np
 
         from ..core.transition import pop_registry_changes
@@ -241,6 +280,8 @@ class AttestationPool:
             self.pubkey_table.sync(state.validators,
                                    changed=pop_registry_changes(state))
             for committee, att in self._slot_entries(state, slot):
+                if exclude is not None and id(att) in exclude:
+                    continue
                 comm = np.asarray(committee, dtype=np.int32)
                 bits = np.asarray(att.aggregation_bits, dtype=bool)
                 domain = get_domain(state, cfg.domain_beacon_attester,
@@ -311,8 +352,11 @@ def _pack_index_rows(rows):
 # full pure-Python subgroup check (~100 ms/key on this host class) —
 # re-deriving the same registry keys every slot dominated the pure
 # builder.  The xla path never touches this (it gathers rows from the
-# device-resident PubkeyTable).
+# device-resident PubkeyTable).  BOUNDED (ISSUE 13): registry churn
+# mints fresh pubkeys forever; FIFO eviction (dict insertion order)
+# caps the footprint — a replaced key re-derives at the usual cost.
 _PK_OBJ_CACHE: dict[bytes, "bls.PublicKey"] = {}
+_PK_OBJ_CACHE_MAX = 4096
 
 
 def _pubkey_object(raw: bytes) -> "bls.PublicKey":
@@ -320,6 +364,11 @@ def _pubkey_object(raw: bytes) -> "bls.PublicKey":
     pk = _PK_OBJ_CACHE.get(raw)
     if pk is None:
         pk = bls.PublicKey.from_bytes(raw)
+        while len(_PK_OBJ_CACHE) >= _PK_OBJ_CACHE_MAX:
+            from ..monitoring.metrics import metrics as _m
+
+            _PK_OBJ_CACHE.pop(next(iter(_PK_OBJ_CACHE)))
+            _m.inc("pk_obj_cache_evictions")
         _PK_OBJ_CACHE[raw] = pk
     return pk
 

@@ -79,7 +79,9 @@ def _register_counters() -> None:
               "bisection_device_verifies", "soak_slots",
               "admission_admits", "admission_rejections",
               "shed_deadline_exceeded", "dispatch_deadline_refusals",
-              "depth_autotune_raise", "depth_autotune_lower"):
+              "depth_autotune_raise", "depth_autotune_lower",
+              "session_registrations", "session_rejections",
+              "feeder_submits", "feeder_demotions"):
         m.inc(c, 0)
 
 
@@ -981,9 +983,317 @@ def run_overload(n_steps: int = 40, seed: int = 1337,
     }
 
 
+# --- multi-tenant front end (PR 13) ------------------------------------------
+
+
+class _SynthValidator:
+    """Registry row stub for the multi-tenant table: ``PubkeyTable
+    .sync`` reads only ``.pubkey``, and a half-million real proto
+    ``Validator`` objects would spend the whole budget on field
+    bookkeeping that isn't under test here."""
+
+    __slots__ = ("pubkey",)
+
+    def __init__(self, pubkey: bytes):
+        self.pubkey = pubkey
+
+
+@contextmanager
+def synthetic_registry():
+    """Swap ``PubkeyTable._decompress_rows`` for a zero-field stub so
+    a 500k-row registry syncs in milliseconds instead of hours of
+    381-bit limb emulation on CPU.  Everything AROUND the decompress
+    stays real — growth bucketing, device commit, host mirror, the
+    tail reorg sentinel — which is the machinery the multi-tenant
+    tier leans on.  Same justification as :func:`synthetic_crypto`:
+    the field math's contract is carried crypto-true by the tier-1
+    decompress/verify tests."""
+    from ..crypto.bls.bls import PubkeyTable
+
+    def _rows(self, pubs):
+        import jax.numpy as jnp
+
+        from ..crypto.bls.xla import limbs as L
+
+        n = len(pubs)
+        return (jnp.zeros((n, L.NLIMBS), jnp.uint32),
+                jnp.zeros((n, L.NLIMBS), jnp.uint32),
+                jnp.zeros((n,), bool))
+
+    saved = PubkeyTable._decompress_rows
+    PubkeyTable._decompress_rows = _rows
+    try:
+        yield
+    finally:
+        PubkeyTable._decompress_rows = saved
+
+
+class MultiTenantStorm:
+    """Deterministic multi-tenant ingress: each step a round-robin
+    window of ``per_step`` DISTINCT sessions submits once — a full
+    storm walks the entire session population, so "10k concurrent
+    sessions" means 10k identities actually submitting, not 10k rows
+    in a dict — plus a greedy hog (``tenant-0``) stacking an extra
+    ``hog_share`` of the window on top, the shape the per-client
+    admission credits must absorb without starving polite tenants."""
+
+    def __init__(self, n_sessions: int = 10_000, per_step: int = 256,
+                 seed: int = 1337, hog_share: float = 0.25):
+        self.n_sessions = max(2, int(n_sessions))
+        self.per_step = int(per_step)
+        self.seed = int(seed)
+        self.hog_extra = max(1, round(self.per_step * hog_share))
+        self.generated = 0
+        self.per_client: dict[str, int] = {}
+        self.sessions_seen: set[str] = set()
+
+    def burst(self, step: int) -> list[str]:
+        """Client ids for this step's submissions, one per entry."""
+        start = step * self.per_step
+        ids = ["tenant-%d" % ((start + i) % self.n_sessions)
+               for i in range(self.per_step)]
+        ids.extend("tenant-0" for _ in range(self.hog_extra))
+        digest = _h(self.seed, "mt", step)
+        for j in range(digest[0] % 4):     # seeded jitter tail
+            ids.append("tenant-%d" % (
+                int.from_bytes(digest[1 + 4 * j:5 + 4 * j], "big")
+                % self.n_sessions))
+        for cid in ids:
+            self.per_client[cid] = self.per_client.get(cid, 0) + 1
+            self.sessions_seen.add(cid)
+        self.generated += len(ids)
+        return ids
+
+
+def run_multitenant(n_sessions: int = 10_000,
+                    n_validators: int = 500_000,
+                    n_steps: int = 44, per_step: int = 256,
+                    seed: int = 1337, hog_share: float = 0.25,
+                    atts_per_slot: int = 2, poison_rate: float = 0.05,
+                    max_pending: int = 64, claim_lag: int = 32,
+                    max_depth: int = 8, warmup: int = 8,
+                    storm_start: int | None = None,
+                    storm_len: int = 6,
+                    deadline_budget_s: float | None = None) -> dict:
+    """Multi-tenant storm: ``n_sessions`` registered client sessions
+    (each bound to validator rows of an ``n_validators``-row
+    ``PubkeyTable``) submitting through a ``SessionRegistry`` over the
+    PR-12 admission credits into one shared ``StreamScheduler``, with
+    a device-fault chaos window live mid-storm.
+
+    Every submission charges ``SessionRegistry.admit`` (the session
+    ledger and the admission token buckets move together); admitted
+    work carries a deadline and is claimed with bounded lag.  The
+    round-robin storm guarantees the WHOLE session population
+    submits.  The report carries the overload ledger (``rejections +
+    sheds + verdicts == submissions``), p99 admitted-work latency for
+    the unloaded and storm phases, and a fairness block: the hog's
+    acceptance rate vs the polite tenants' (credits must throttle the
+    hog, not the crowd).
+
+    Crypto is synthetic (:func:`synthetic_crypto`) and the table rows
+    are synthetic (:func:`synthetic_registry`); the machinery under
+    load — sessions, admission, scheduler, ladder, breaker — is real.
+    """
+    from ..aggregation.sessions import SessionRegistry
+    from ..crypto.bls import bls
+    from ..sched import StreamScheduler
+    from ..sched.autotune import DepthAutoTuner
+    from .admission import AdmissionController, AdmissionRejected
+
+    if storm_start is None:
+        storm_start = max(4, n_steps // 3)
+    m = _metrics()
+    before = {c: _counter(c) for c in (
+        "admission_admits", "admission_rejections",
+        "shed_deadline_exceeded", "depth_autotune_raise",
+        "depth_autotune_lower", "fail_closed_abandons",
+        "session_registrations", "session_rejections",
+        "degraded_dispatches", "breaker_trips")}
+    hist = m.histogram("admitted_verdict_latency_seconds")
+    verdicts_before = hist.n
+    bls.fused_breaker.reset()
+
+    scheduler = StreamScheduler(max_slots=1, linger_s=300.0)
+    admission = AdmissionController(scheduler=scheduler,
+                                    max_pending=max_pending)
+    admission.reset_episodes()
+    tuner = DepthAutoTuner(scheduler, max_depth=max_depth,
+                           register_flight=True)
+    sessions = SessionRegistry(admission=admission)
+    sessions.register_flight()
+
+    storm = MultiTenantStorm(n_sessions=n_sessions, per_step=per_step,
+                             seed=seed, hog_share=hog_share)
+
+    est = m.histogram("stage_device_compute_seconds").quantile(0.9)
+    storm_deadline_s = max(0.25, 20.0 * est)
+
+    submissions = 0
+    rejections = 0
+    outstanding: list[tuple[int, list]] = []
+    divergences: list[str] = []
+    false_on_true = 0
+    depth_trace: list[int] = []
+    steps_run = 0
+    partial = False
+    slot_counter = 0
+    chaos_cm = None
+    t0 = time.monotonic()
+
+    def _claim_one() -> None:
+        nonlocal false_on_true
+        handle, golden = outstanding.pop(0)
+        got = bool(scheduler.result(handle))
+        want = all(golden)
+        if got and not want:
+            divergences.append(
+                f"handle {handle}: verdict True but golden has a "
+                f"poisoned entry")
+        elif want and not got:
+            false_on_true += 1
+
+    def _submit_one(client_id: str, deadline) -> None:
+        nonlocal submissions, rejections, slot_counter
+        submissions += 1
+        try:
+            sessions.admit(client_id)
+        except AdmissionRejected:
+            rejections += 1
+            return
+        digest = _h(seed, "mtpoison", slot_counter)
+        poisoned = (0,) if digest[0] / 255.0 < poison_rate else ()
+        batch, golden = build_synthetic_batch(
+            table, slot_counter, atts_per_slot, n_validators,
+            seed=seed, poisoned=poisoned)
+        slot_counter += 1
+        # poisoned batches carry NO deadline so a golden-False entry
+        # can never be shed — keeps false_on_true == sheds exact
+        dl = None if poisoned else deadline
+        outstanding.append((scheduler.submit(batch, deadline=dl),
+                            golden))
+
+    try:
+        with synthetic_registry(), synthetic_crypto():
+            # the 500k-row registry: synced through the REAL bucketing
+            # / device-commit / host-mirror path, rows stubbed
+            table = bls.PubkeyTable()
+            table.sync([_SynthValidator(i.to_bytes(48, "big"))
+                        for i in range(n_validators)])
+
+            # register the whole tenant population up front, each
+            # bound to its validator rows
+            for i in range(n_sessions):
+                sessions.register(
+                    "tenant-%d" % i,
+                    validators=(i % n_validators,
+                                (i * 31 + 7) % n_validators))
+
+            # 1. warmup: unloaded latency baseline
+            lat0 = len(hist.samples)
+            for _ in range(warmup):
+                _submit_one("warmup", None)
+                scheduler.flush()
+                while outstanding:
+                    _claim_one()
+            lat1 = len(hist.samples)
+
+            # 2. the storm, with a chaos window live mid-way
+            for step in range(n_steps):
+                if deadline_budget_s is not None and (
+                        time.monotonic() - t0) > deadline_budget_s:
+                    partial = True
+                    break
+                if step == storm_start and storm_len > 0:
+                    chaos_cm = _faults.inject(
+                        seed=seed, device_dispatch={"rate": 1.0})
+                    chaos_cm.__enter__()
+                elif step == storm_start + storm_len and (
+                        chaos_cm is not None):
+                    chaos_cm.__exit__(None, None, None)
+                    chaos_cm = None
+                for cid in storm.burst(step):
+                    _submit_one(
+                        cid, time.monotonic() + storm_deadline_s)
+                tuner.tick()
+                depth_trace.append(scheduler.max_slots)
+                while len(outstanding) > claim_lag:
+                    _claim_one()
+                steps_run += 1
+            scheduler.flush()
+            while outstanding:
+                _claim_one()
+            lat2 = len(hist.samples)
+
+            # 3. cooldown + clean close: zero abandons required
+            for _ in range(6):
+                tuner.tick()
+            scheduler.close()
+    finally:
+        if chaos_cm is not None:
+            chaos_cm.__exit__(None, None, None)
+        bls.fused_breaker.reset()
+
+    delta = {c: _counter(c) - before[c] for c in before}
+    verdicts = hist.n - verdicts_before
+    sheds = delta["shed_deadline_exceeded"]
+    unloaded_p99 = _p99(list(hist.samples[lat0:lat1]))
+    loaded_p99 = _p99(list(hist.samples[lat1:lat2]))
+    accepted = sessions.accepted_by_client()
+    hog_submitted = storm.per_client.get("tenant-0", 0)
+    hog_accepted = accepted.get("tenant-0", 0)
+    polite_submitted = storm.generated - hog_submitted
+    polite_accepted = (sum(accepted.values()) - hog_accepted
+                       - accepted.get("warmup", 0))
+    elapsed = time.monotonic() - t0
+    return {
+        "steps": steps_run,
+        "partial": partial,
+        "elapsed_s": round(elapsed, 3),
+        "sessions": len(sessions),
+        "sessions_submitting": len(storm.sessions_seen),
+        "table_rows": table.n,
+        "chaos": storm_len > 0 and steps_run > storm_start,
+        "submissions": submissions,
+        "rejections": rejections,
+        "admitted": submissions - rejections,
+        "sheds": int(sheds),
+        "verdicts": int(verdicts),
+        "accounting_ok": rejections + sheds + verdicts == submissions,
+        "shed_accounting_ok": false_on_true == sheds,
+        "false_on_true": false_on_true,
+        "divergences": divergences,
+        "fail_closed_abandons": int(delta["fail_closed_abandons"]),
+        "degraded_dispatches": int(delta["degraded_dispatches"]),
+        "breaker_trips": int(delta["breaker_trips"]),
+        "session_registrations": int(delta["session_registrations"]),
+        "session_rejections": int(delta["session_rejections"]),
+        "unloaded_p99_s": round(unloaded_p99, 6),
+        "loaded_p99_s": round(loaded_p99, 6),
+        "fairness": {
+            "hog_submitted": hog_submitted,
+            "hog_accepted": hog_accepted,
+            "hog_accept_rate": round(
+                hog_accepted / max(hog_submitted, 1), 4),
+            "polite_accept_rate": round(
+                polite_accepted / max(polite_submitted, 1), 4),
+        },
+        "depth": {
+            "max_reached": max(depth_trace) if depth_trace else 1,
+            "final": scheduler.max_slots,
+            "raises": int(delta["depth_autotune_raise"]),
+            "lowers": int(delta["depth_autotune_lower"]),
+        },
+        "admission": admission.snapshot(),
+        "sessions_snapshot": sessions.snapshot(),
+    }
+
+
 __all__ = [
-    "OverloadStorm", "ReorgStorm", "SlashingFlood", "RegistryChurn",
-    "ScenarioSchedule", "SlowClient", "build_synthetic_batch",
-    "poison_signature", "run_overload", "run_soak",
-    "synthetic_crypto", "synthetic_pubkey", "synthetic_signature",
+    "MultiTenantStorm", "OverloadStorm", "ReorgStorm",
+    "SlashingFlood", "RegistryChurn", "ScenarioSchedule",
+    "SlowClient", "build_synthetic_batch", "poison_signature",
+    "run_multitenant", "run_overload", "run_soak",
+    "synthetic_crypto", "synthetic_pubkey", "synthetic_registry",
+    "synthetic_signature",
 ]

@@ -323,11 +323,25 @@ class SyncService:
             return self._verify_slot_batch(slot)
 
     def _verify_slot_batch(self, slot: int) -> bool:
-        from ..core.helpers import is_valid_indexed_attestation
-        from ..core.helpers import get_indexed_attestation
-
         state = self.chain.head_state
         from ..config import features
+
+        # opportunistic feeder (aggregation/feeder.py): work that
+        # matured between ticks is already riding the scheduler —
+        # claim those verdicts first, then build the REMAINDER so
+        # nothing verifies twice
+        feeder = getattr(self.att_pool, "feeder", None)
+        all_ok = True
+        exclude = None
+        if feeder is not None:
+            for fed_batch, fed_ok in feeder.collect(slot):
+                if self.metrics is not None:
+                    self.metrics.inc("slot_batch_signatures",
+                                     len(fed_batch))
+                if not self._consume_batch_verdict(state, fed_batch,
+                                                   fed_ok):
+                    all_ok = False
+            exclude = feeder.fed_ids(slot) or None
 
         indexed = False
         if features().bls_implementation in ("xla", "pallas"):
@@ -336,7 +350,7 @@ class SyncService:
             # verify dispatch — no pure-Python point math per slot
             try:
                 batch = self.att_pool.build_slot_batch_indexed(
-                    state, slot)
+                    state, slot, exclude=exclude)
                 indexed = True
             except Exception as fault:  # noqa: BLE001
                 from ..runtime import faults as _faults
@@ -344,7 +358,10 @@ class SyncService:
                 if not _faults.is_transient(fault):
                     raise
                 # transient device fault syncing the pubkey table:
-                # degrade to the host object batch for this slot
+                # degrade to the host object batch for this slot.
+                # (No exclude here: a fed attestation re-verifies on
+                # the host — harmless double work, vote processing is
+                # idempotent per validator.)
                 from ..monitoring.metrics import metrics as _m
 
                 _m.inc("degraded_dispatches")
@@ -353,7 +370,7 @@ class SyncService:
         else:
             batch = self.att_pool.build_slot_signature_batch(state, slot)
         if len(batch) == 0:
-            return True
+            return all_ok
         # indexed slot batches ride the chain's streaming scheduler:
         # at N=1 a passthrough fused dispatch; at sync depth this
         # slot's work joins the in-progress megabatch.  Bisection on a
@@ -363,6 +380,15 @@ class SyncService:
               else batch.verify())
         if self.metrics is not None:
             self.metrics.inc("slot_batch_signatures", len(batch))
+        return self._consume_batch_verdict(state, batch, ok) and all_ok
+
+    def _consume_batch_verdict(self, state, batch, ok: bool) -> bool:
+        """Turn one batch verdict into votes + observer feeds.  Shared
+        by the tick batch and the feeder's fed batches — the verdict-
+        consumption rules are identical."""
+        from ..core.helpers import is_valid_indexed_attestation
+        from ..core.helpers import get_indexed_attestation
+
         # only the batch's OWN entries (captured under the pool lock
         # at build time) are signature-verified by the verdict;
         # re-scanning the pool here would be a TOCTOU hole — an

@@ -1,0 +1,543 @@
+"""Aggregation engine (ISSUE 13): device-resident coalescing parity,
+the opportunistic feeder's maturity policy, the multi-tenant session
+front end, and the ingress-stall lock fix.
+
+Tier-1 scope: the greedy planner's decision order, the pure
+coalescing path against the ``Signature.aggregate`` golden fold, the
+batch-shrink property, feeder policy on fakes, session fairness, the
+pk-object cache bound, and a small multi-tenant smoke.  The device
+dispatch parity tests and the full 10k-session storm are slow-marked
+(`make multitenant`): the coalesce graph costs minutes of CPU compile
+per bucket shape.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from prysm_tpu.config import (
+    set_features, use_mainnet_config, use_minimal_config,
+)
+from prysm_tpu.crypto.bls import bls
+from prysm_tpu.monitoring.metrics import metrics
+from prysm_tpu.operations import AttestationPool
+from prysm_tpu.operations.attestations import _group_key
+from prysm_tpu.proto import Attestation, build_types
+from prysm_tpu.testing import util as testutil
+from prysm_tpu.aggregation.engine import CoalesceEngine, plan_merges
+from prysm_tpu.aggregation.feeder import OpportunisticFeeder
+from prysm_tpu.aggregation.sessions import SessionRegistry
+from prysm_tpu.runtime.scenarios import (
+    run_multitenant, synthetic_registry,
+)
+
+
+@pytest.fixture(scope="module")
+def env():
+    use_minimal_config()
+    set_features(bls_implementation="pure")
+    from prysm_tpu.config import MINIMAL_CONFIG
+
+    types = build_types(MINIMAL_CONFIG)
+    genesis = testutil.deterministic_genesis_state(16, types)
+    from prysm_tpu.core.transition import process_slots
+
+    st = genesis.copy()
+    process_slots(st, 3, types)
+    yield types, st
+    use_mainnet_config()
+
+
+@pytest.fixture
+def xla_features():
+    set_features(bls_implementation="xla")
+    bls.fused_breaker.reset()
+    yield
+    set_features(bls_implementation="pure")
+    bls.fused_breaker.reset()
+
+
+def single_bit_atts(state, slot, index):
+    from prysm_tpu.core.helpers import get_beacon_committee
+
+    committee = get_beacon_committee(state, slot, index)
+    atts = []
+    for pos in range(len(committee)):
+        bits = [p == pos for p in range(len(committee))]
+        atts.append(testutil.valid_attestation(state, slot, index,
+                                               bits=bits))
+    return atts, committee
+
+
+def _golden_fold(sig_bytes_list):
+    acc = bls.Signature.from_bytes(sig_bytes_list[0])
+    for s in sig_bytes_list[1:]:
+        acc = bls.Signature.aggregate(
+            [acc, bls.Signature.from_bytes(s)])
+    return acc.to_bytes()
+
+
+# --- the greedy planner (host, no crypto) -----------------------------------
+
+
+def _fake(bits):
+    return SimpleNamespace(aggregation_bits=list(bits))
+
+
+class TestPlanner:
+    def test_decision_order(self):
+        A = _fake([1, 1, 0, 0, 0])
+        s_sub = _fake([0, 1, 0, 0, 0])    # subset of A -> dropped
+        s_bad = _fake([0, 0, 1, 0, 0])    # malformed -> dropped
+        s1 = _fake([0, 0, 1, 0, 0])       # merges into A
+        s2 = _fake([0, 0, 1, 1, 0])       # overlaps merged A -> new
+        s3 = _fake([0, 0, 0, 0, 1])       # first-fit back into A
+        plans, n_sub, n_mal = plan_merges(
+            [A], [s_sub, s_bad, s1, s2, s3], bad={id(s_bad)})
+        assert (n_sub, n_mal) == (1, 1)
+        assert len(plans) == 2
+        assert plans[0].base is A and plans[0].members == [s1, s3]
+        assert plans[0].bits == [True, True, True, False, True]
+        assert plans[1].base is s2 and plans[1].is_new
+        assert not plans[1].members
+
+    def test_subset_checked_before_malformed(self):
+        # the pure loop drops a covered single WITHOUT parsing its
+        # signature — a malformed subset single counts subset, not
+        # malformed
+        A = _fake([1, 1, 0])
+        s = _fake([0, 1, 0])
+        plans, n_sub, n_mal = plan_merges([A], [s], bad={id(s)})
+        assert (n_sub, n_mal) == (1, 0)
+        assert len(plans) == 1 and not plans[0].members
+
+    def test_frozen_aggregate_never_merged_into(self):
+        A = _fake([1, 0, 0])
+        s = _fake([0, 1, 0])
+        plans, _, _ = plan_merges([A], [s], bad={id(A)})
+        assert plans[0].frozen and not plans[0].members
+        assert plans[1].base is s and plans[1].is_new
+
+    def test_appended_single_becomes_merge_candidate(self):
+        s1 = _fake([1, 0, 0])
+        s2 = _fake([0, 1, 0])
+        plans, _, _ = plan_merges([], [s1, s2], bad=set())
+        assert len(plans) == 1
+        assert plans[0].base is s1 and plans[0].members == [s2]
+
+
+# --- pure coalescing path vs the golden fold --------------------------------
+
+
+class TestPureCoalesce:
+    def test_matches_signature_aggregate(self, env):
+        types, st = env
+        atts, committee = single_bit_atts(st, 1, 0)
+        key = _group_key(atts[0])
+        out, stats = CoalesceEngine()._coalesce_pure(
+            {key: (list(atts), [])})
+        (agg,) = out[key]
+        assert all(agg.aggregation_bits)
+        golden = _golden_fold([bytes(a.signature) for a in atts])
+        assert bytes(agg.signature) == golden
+        # and the pure fold equals the directly-signed full aggregate
+        full = testutil.valid_attestation(st, 1, 0)
+        assert bytes(agg.signature) == bytes(full.signature)
+        assert stats["agg_groups_coalesced"] == 1
+        assert stats["agg_singles_merged"] == len(atts) - 1
+
+    def test_malformed_single_dropped(self, env):
+        types, st = env
+        atts, _ = single_bit_atts(st, 1, 0)
+        bad = Attestation(
+            aggregation_bits=list(atts[1].aggregation_bits),
+            data=atts[1].data, signature=b"\x00" * 96)
+        key = _group_key(atts[0])
+        out, stats = CoalesceEngine()._coalesce_pure(
+            {key: ([atts[0], bad], [])})
+        assert stats["agg_malformed_dropped"] == 1
+        assert stats["agg_singles_merged"] == 0
+        assert out[key] == [atts[0]]   # memberless plan: unchanged
+
+    def test_pool_coalesce_shrinks_slot_batch(self, env):
+        """The acceptance shape: N singles of one group collapse to
+        ONE IndexedSlotBatch entry after coalescing."""
+        types, st = env
+        pool = AttestationPool()
+        atts, committee = single_bit_atts(st, 1, 0)
+        for a in atts:
+            pool.save_unaggregated(a)
+        with synthetic_registry():
+            before = pool.build_slot_batch_indexed(st, 1)
+            pool.aggregate_unaggregated()
+            after = pool.build_slot_batch_indexed(st, 1)
+        assert len(before) == len(committee)
+        assert len(after) == 1
+        assert len(after) < len(before)
+        assert pool.unaggregated_count() == 0
+
+
+# --- the ingress-stall lock fix ---------------------------------------------
+
+
+class TestAggregationLock:
+    def test_ingress_unblocked_and_merge_back_recheck(self, env):
+        """aggregate_unaggregated must NOT hold the pool lock across
+        the point math, and its merge-back must subset-dedup against
+        aggregates that arrived meanwhile."""
+        types, st = env
+        pool = AttestationPool()
+        atts, committee = single_bit_atts(st, 1, 0)
+        full = testutil.valid_attestation(st, 1, 0)
+        pool.save_unaggregated(atts[0])
+        started, release = threading.Event(), threading.Event()
+
+        class _SlowEngine:
+            def coalesce(self, snapshots):
+                started.set()
+                assert release.wait(20)
+                ((key, (pending, _aggregated)),) = snapshots.items()
+                # echo the single back as a 1-bit "aggregate": a
+                # strict subset of the full aggregate arriving below
+                return {key: [Attestation(
+                    aggregation_bits=list(pending[0].aggregation_bits),
+                    data=pending[0].data,
+                    signature=bytes(pending[0].signature))]}
+
+        pool._engine = _SlowEngine()
+        t = threading.Thread(target=pool.aggregate_unaggregated)
+        t.start()
+        assert started.wait(10)
+        # backstop: if ingress deadlocks on the pool lock, unblock the
+        # engine after 8s so the test fails on the timing assert
+        # instead of hanging
+        backstop = threading.Timer(8.0, release.set)
+        backstop.start()
+        t0 = time.monotonic()
+        pool.save_aggregated(full)     # ingress while math in flight
+        ingress_s = time.monotonic() - t0
+        release.set()
+        t.join(10)
+        backstop.cancel()
+        assert not t.is_alive()
+        assert ingress_s < 5.0, \
+            f"ingress stalled {ingress_s:.1f}s behind aggregation"
+        # merge-back re-check: coalesced 1-bit output is a subset of
+        # the arrived full aggregate -> deduped, full survives
+        aggs = pool.aggregated_for_block(slot=1)
+        assert len(aggs) == 1
+        assert all(aggs[0].aggregation_bits)
+
+
+# --- pk-object cache bound ---------------------------------------------------
+
+
+class TestPkObjCache:
+    def test_bounded_with_eviction_counter(self, monkeypatch):
+        from prysm_tpu.operations import attestations as ops
+
+        monkeypatch.setattr(ops, "_PK_OBJ_CACHE_MAX", 4)
+        monkeypatch.setattr(ops.bls.PublicKey, "from_bytes",
+                            staticmethod(lambda raw: object()))
+        ops._PK_OBJ_CACHE.clear()
+        before = metrics.counter("pk_obj_cache_evictions").value
+        for i in range(10):
+            ops._pubkey_object(b"pk-%d" % i)
+        assert len(ops._PK_OBJ_CACHE) <= 4
+        evicted = metrics.counter("pk_obj_cache_evictions").value - before
+        assert evicted >= 6
+        pk = ops._pubkey_object(b"pk-9")        # cache hit
+        assert ops._pubkey_object(b"pk-9") is pk
+        ops._PK_OBJ_CACHE.clear()
+
+
+# --- the opportunistic feeder ------------------------------------------------
+
+
+class _FakeBatch:
+    def __init__(self, atts):
+        self.attestations = list(atts)
+
+    def __len__(self):
+        return len(self.attestations)
+
+
+class _FakePool:
+    def __init__(self, atts):
+        self.atts = list(atts)
+        self.aggregate_calls = 0
+        self.last_exclude = None
+
+    def aggregate_unaggregated(self):
+        self.aggregate_calls += 1
+
+    def build_slot_batch_indexed(self, state, slot, exclude=None):
+        self.last_exclude = exclude
+        keep = [a for a in self.atts if a.data.slot == slot
+                and (not exclude or id(a) not in exclude)]
+        return _FakeBatch(keep)
+
+
+class _FakeScheduler:
+    def __init__(self):
+        self.submitted = []
+        self.default_deadline_s = None
+
+    def submit(self, batch, deadline=None):
+        self.submitted.append(batch)
+        return len(self.submitted) - 1
+
+    def result(self, handle):
+        return True
+
+
+def _feeder_att(slot, bit, nbits=4):
+    return SimpleNamespace(
+        data=SimpleNamespace(slot=slot, index=0,
+                             beacon_block_root=b"root"),
+        aggregation_bits=[i == bit for i in range(nbits)],
+        signature=b"\x00" * 96)
+
+
+class TestFeeder:
+    def _mk(self, atts, quorum=0.5, linger_s=2.0):
+        clock = [0.0]
+        fp = _FakePool(atts)
+        fs = _FakeScheduler()
+        f = OpportunisticFeeder(fp, fs, state_fn=lambda: None,
+                                quorum=quorum, linger_s=linger_s,
+                                time_fn=lambda: clock[0])
+        return f, fp, fs, clock
+
+    def test_noop_under_pure_backend(self, env):
+        f, fp, fs, _ = self._mk([_feeder_att(5, 0)])
+        f.notify(fp.atts[0])
+        assert f.snapshot()["tracked_groups"] == 0
+        assert not fs.submitted
+
+    def test_coverage_quorum_feeds(self, env, xla_features):
+        a0, a1 = _feeder_att(5, 0), _feeder_att(5, 1)
+        f, fp, fs, _ = self._mk([a0, a1])
+        f.notify(a0)                       # 1/4 < 0.5: tracked only
+        assert not fs.submitted
+        assert f.snapshot()["tracked_groups"] == 1
+        f.notify(a1)                       # OR'd 2/4 >= 0.5: feed
+        assert fp.aggregate_calls == 1
+        assert len(fs.submitted) == 1
+        assert f.fed_ids(5) == frozenset(id(a) for a in (a0, a1))
+        assert f.snapshot()["tracked_groups"] == 0
+
+    def test_linger_bound_feeds_thin_traffic(self, env, xla_features):
+        a0 = _feeder_att(5, 0)
+        f, fp, fs, clock = self._mk([a0], linger_s=2.0)
+        f.notify(a0)
+        f.tick()
+        assert not fs.submitted            # not lingered yet
+        clock[0] = 2.5
+        f.tick()
+        assert len(fs.submitted) == 1
+
+    def test_deadline_pressure_tightens_linger(self, env, xla_features):
+        a0 = _feeder_att(5, 0)
+        f, fp, fs, clock = self._mk([a0], linger_s=10.0)
+        fs.default_deadline_s = 1.0        # bound = min(10, 0.5)
+        f.notify(a0)
+        clock[0] = 0.6
+        f.tick()
+        assert len(fs.submitted) == 1
+
+    def test_breaker_open_demotes(self, env, xla_features,
+                                  monkeypatch):
+        a0, a1 = _feeder_att(5, 0), _feeder_att(5, 1)
+        f, fp, fs, _ = self._mk([a0, a1])
+        monkeypatch.setattr(
+            bls, "fused_breaker",
+            SimpleNamespace(is_open=lambda: True, reset=lambda: None))
+        before = metrics.counter("feeder_demotions").value
+        f.notify(a0)
+        f.notify(a1)                       # quorum reached -> feed()
+        assert not fs.submitted            # ...but demoted
+        assert metrics.counter("feeder_demotions").value == before + 1
+
+    def test_collect_and_exclude(self, env, xla_features):
+        a0, a1 = _feeder_att(5, 0), _feeder_att(5, 1)
+        late = _feeder_att(5, 2)
+        f, fp, fs, _ = self._mk([a0, a1])
+        f.notify(a0)
+        f.notify(a1)
+        assert len(fs.submitted) == 1
+        fp.atts.append(late)               # arrives after the feed
+        # the tick build excludes fed work; the late single remains
+        batch = fp.build_slot_batch_indexed(None, 5,
+                                            exclude=f.fed_ids(5))
+        assert [id(a) for a in batch.attestations] == [id(late)]
+        pairs = f.collect(5)
+        assert len(pairs) == 1 and pairs[0][1] is True
+        assert f.collect(5) == []          # claimed exactly once
+        f.prune_before(6)
+        assert f.fed_ids(5) == frozenset()
+
+    def test_empty_batch_not_submitted(self, env, xla_features):
+        a0, a1 = _feeder_att(5, 0), _feeder_att(5, 1)
+        f, fp, fs, _ = self._mk([])        # pool yields nothing
+        f.notify(a0)
+        f.notify(a1)
+        assert not fs.submitted
+        assert metrics.counter("feeder_submits").value >= 0
+
+
+# --- sessions over the admission credits ------------------------------------
+
+
+class TestSessions:
+    def test_two_tenant_hog_fairness(self):
+        from prysm_tpu.runtime.admission import (
+            AdmissionController, AdmissionRejected,
+        )
+
+        admission = AdmissionController(
+            scheduler=None, max_pending=1_000_000,
+            queue_wait_p90_s=1e9, credits_per_client=4.0,
+            refill_per_s=0.0, register_flight=False)
+        reg = SessionRegistry(admission=admission)
+        rejected = 0
+        for i in range(40):
+            cid = "hog" if i % 2 == 0 else "polite-%d" % (i // 2)
+            try:
+                reg.admit(cid)
+            except AdmissionRejected:
+                rejected += 1
+        acc = reg.accepted_by_client()
+        # the hog burns its 4 burst credits; every polite tenant's
+        # single submission is admitted
+        assert acc["hog"] == 4
+        assert all(acc["polite-%d" % k] == 1 for k in range(20))
+        assert rejected == 16
+        assert len(reg) == 21
+        snap = reg.snapshot()
+        assert snap["top_talker"]["client_id"] == "hog"
+        assert snap["rejected"] == 16
+        sess = reg.get("hog")
+        assert (sess.submitted, sess.accepted, sess.rejected) == \
+            (20, 4, 16)
+
+    def test_register_binds_validators_once(self):
+        reg = SessionRegistry()
+        before = metrics.counter("session_registrations").value
+        s1 = reg.register("c1", validators=(3, 7))
+        s2 = reg.register("c1", validators=(9,))   # already known
+        assert s1 is s2 and s1.validators == (3, 7)
+        assert metrics.counter("session_registrations").value == \
+            before + 1
+        reg.admit("c1")       # no admission wired: always accepted
+        assert reg.get("c1").accepted == 1
+
+
+# --- multi-tenant storm smoke (full 10k run is slow-marked) ------------------
+
+
+class TestMultiTenant:
+    def test_smoke(self, xla_features):
+        report = run_multitenant(
+            n_sessions=32, n_validators=64, n_steps=6, per_step=8,
+            seed=7, warmup=2, storm_start=2, storm_len=2,
+            claim_lag=8, max_depth=4)
+        assert report["accounting_ok"], report
+        assert not report["divergences"], report["divergences"]
+        assert report["fail_closed_abandons"] == 0, report
+        assert report["table_rows"] == 64
+        assert report["sessions_submitting"] == 32
+        assert report["sessions"] >= 32
+        assert report["chaos"]
+        assert report["verdicts"] > 0
+
+    @pytest.mark.slow
+    def test_full_10k_sessions_500k_table(self, xla_features):
+        report = run_multitenant()
+        assert report["sessions"] >= 10_000
+        assert report["sessions_submitting"] >= 10_000
+        assert report["table_rows"] == 500_000
+        assert report["chaos"]
+        assert report["accounting_ok"], report
+        assert not report["divergences"], report["divergences"]
+        assert report["fail_closed_abandons"] == 0, report
+        fair = report["fairness"]
+        assert fair["polite_accept_rate"] >= fair["hog_accept_rate"], \
+            fair
+
+
+# --- device dispatch parity (slow: minutes of CPU compile) -------------------
+
+
+@pytest.mark.slow
+class TestDeviceCoalesce:
+    def test_batch_parity_vs_pure_golden(self, env):
+        """One dispatch, three groups: full merge vs the
+        ``Signature.aggregate`` fold, identity round-trip, and
+        aggregation with the canonical infinity point — plus the
+        malformed-signature validity mask."""
+        from prysm_tpu.crypto.bls.xla.aggregate import (
+            INF_G2, g2_coalesce_batch, pack_bits_u32, unpack_bits_u32,
+        )
+
+        types, st = env
+        singles, committee = single_bit_atts(st, 1, 0)
+        extra = testutil.valid_attestation(st, 2, 0)
+        n = len(committee)
+        sigs = [bytes(a.signature) for a in singles]
+        sigs += [bytes(extra.signature), INF_G2, b"\x00" * 96]
+        i_extra, i_inf, i_bad = n, n + 1, n + 2
+        bitsets = [list(a.aggregation_bits) for a in singles]
+        bitsets += [list(extra.aggregation_bits), [True] * n,
+                    [True] * n]
+        words = [pack_bits_u32(b) for b in bitsets]
+        groups = [
+            list(range(n)),          # every single -> full aggregate
+            [0],                     # identity: recompression round-trip
+            [i_extra, i_inf],        # + infinity == the member alone
+        ]
+        agg_bytes, agg_words, ok = g2_coalesce_batch(sigs, words,
+                                                     groups)
+        assert all(ok[:i_inf])
+        assert ok[i_inf]             # canonical infinity parses fine
+        assert not ok[i_bad]         # matches the pure ValueError
+        with pytest.raises(ValueError):
+            bls.Signature.from_bytes(b"\x00" * 96)
+        assert agg_bytes[0] == _golden_fold(sigs[:n])
+        assert unpack_bits_u32(agg_words[0], n) == [True] * n
+        assert agg_bytes[1] == sigs[0]
+        assert agg_bytes[2] == sigs[i_extra]
+
+    def test_engine_two_pass_replans_on_malformed(self, env):
+        """The device engine learns the malformed set from pass 1's
+        validity mask and re-plans: the bad single is dropped, the
+        valid merge is byte-identical to the golden fold."""
+        types, st = env
+        sig_a = bytes(testutil.valid_attestation(st, 0, 0).signature)
+        sig_1 = bytes(testutil.valid_attestation(st, 1, 0).signature)
+        sig_2 = bytes(testutil.valid_attestation(st, 2, 0).signature)
+        datum = testutil.valid_attestation(st, 1, 0).data
+
+        def att(bits, sig):
+            return Attestation(aggregation_bits=bits, data=datum,
+                               signature=sig)
+
+        agg_in = att([True, True] + [False] * 6, sig_a)
+        s1 = att([False, False, True] + [False] * 5, sig_1)
+        s2 = att([False] * 3 + [True] + [False] * 4, sig_2)
+        bad = att([False] * 4 + [True] + [False] * 3, b"\x00" * 96)
+        key = _group_key(agg_in)
+        d0 = metrics.counter("agg_coalesce_dispatches").value
+        out, stats = CoalesceEngine()._coalesce_device(
+            {key: ([s1, s2, bad], [agg_in])})
+        assert metrics.counter("agg_coalesce_dispatches").value == \
+            d0 + 2                        # pass 1 + the re-plan
+        assert stats["agg_malformed_dropped"] == 1
+        assert stats["agg_singles_merged"] == 2
+        (agg,) = out[key]
+        assert bytes(agg.signature) == _golden_fold(
+            [sig_a, sig_1, sig_2])
+        assert list(agg.aggregation_bits) == \
+            [True] * 4 + [False] * 4
