@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow lint chaos stream soak overload multitenant trace warm-cache dryrun bench native proto race
+.PHONY: test test-slow lint chaos stream soak overload multitenant wire trace warm-cache dryrun bench native proto race
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -73,6 +73,17 @@ overload:
 multitenant:
 	$(PY) -m pytest tests/test_aggregation.py -q -m "slow or not slow" -x
 	PRYSM_TIER_BUDGET=900 $(PY) bench.py --tier multitenant
+
+# Wire-robustness gate (ISSUE 15): the connection-lifecycle matrix
+# (slowloris reaping, malformed frames, cap refusals, graceful drain,
+# client reconnect/breaker), then the 10k-session storm routed over
+# REAL framed-gRPC + HTTP sockets with wire chaos, a slowloris swarm
+# and a flapping client live mid-storm — ledger balanced across the
+# lossy wire, zero lost submissions, threads bounded by the cap,
+# drain leaves nothing unanswered.
+wire:
+	$(PY) -m pytest tests/test_wire.py -q -m "slow or not slow" -x
+	PRYSM_TIER_BUDGET=900 $(PY) bench.py --tier multitenant_sockets
 
 # Observability artifact (ISSUE 11): a short traced soak with the
 # flight recorder armed — writes TRACE_SOAK.json (load at

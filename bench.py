@@ -687,6 +687,72 @@ def bench_multitenant():
     }
 
 
+def bench_multitenant_sockets():
+    """Wire tier: the 10k-session multi-tenant storm of
+    ``bench_multitenant`` routed END-TO-END over real sockets —
+    framed-gRPC and beacon-HTTP carriers, per-connection read
+    deadlines, the accept-gate connection cap — with a live chaos
+    window that layers wire faults (resets mid-frame, torn writes,
+    corrupted frames), a slowloris swarm, and a flapping-client
+    reconnect storm on top of the device fault storm
+    (``runtime/scenarios.run_multitenant_sockets``).  Acceptance: the
+    overload ledger balances across the lossy wire (zero lost
+    submissions), zero fail-closed abandons, handler threads bounded
+    by the connection cap, slowloris reaped within the read deadline,
+    and a graceful drain that leaves no in-flight request unanswered
+    (zero drain fail-closes)."""
+    from prysm_tpu.config import set_features, use_minimal_config
+
+    use_minimal_config()
+    set_features(bls_implementation="xla")
+    from prysm_tpu.runtime.scenarios import run_multitenant_sockets
+
+    tier_budget = float(os.environ.get("PRYSM_TIER_BUDGET", "0"))
+    deadline_s = tier_budget * 0.8 if tier_budget > 0 else None
+    report = run_multitenant_sockets(
+        n_sessions=10_000, n_validators=500_000, seed=1337,
+        deadline_budget_s=deadline_s)
+    assert report["sessions"] >= 10_000, report["sessions"]
+    assert report["sessions_submitting"] >= 10_000, \
+        report["sessions_submitting"]
+    assert report["chaos"], report
+    assert report["accounting_ok"], report
+    assert report["lost"] == 0, report["lost"]
+    assert not report["divergences"], report["divergences"]
+    assert report["fail_closed_abandons"] == 0, report
+    wire = report["wire"]
+    # handler threads strictly bounded by the accept-gate cap
+    assert wire["max_active_connections"] <= wire["connection_cap"], \
+        wire
+    # every held slowloris socket reaped within the read deadline
+    assert wire["loris_reaped"] is True, wire
+    # graceful drain answered every in-flight request
+    assert wire["drain_fail_closed"] == 0, wire
+    # connection ledger balances: everything opened was closed
+    assert wire["connections_opened"] == wire["connections_closed"], \
+        wire
+    fair = report["fairness"]
+    assert fair["polite_accept_rate"] >= fair["hog_accept_rate"], fair
+    return {
+        "metric": "multitenant_sockets_p99_latency_ms",
+        "value": round(report["loaded_p99_s"] * 1e3, 3),
+        "unit": (f"ms admitted-work p99 over real sockets "
+                 f"({report['sessions_submitting']} sessions, "
+                 f"{report['submissions']} submissions"
+                 f"{', PARTIAL' if report['partial'] else ''}: "
+                 f"{report['rejections']} rejected, "
+                 f"{report['sheds']} shed, "
+                 f"{report['verdicts']} verdicts, 0 lost; "
+                 f"{wire['tcp_submissions']} tcp + "
+                 f"{wire['http_submissions']} http, "
+                 f"{wire['reaps']} reaps, "
+                 f"{wire['conn_errors']} conn errors, max "
+                 f"{wire['max_active_connections']}/"
+                 f"{wire['connection_cap']} conns)"),
+        "vs_baseline": 0.0,
+    }
+
+
 TIERS = [
     # (name, fn, wall budget seconds — generous for first compiles;
     # the persistent cache makes reruns fast)
@@ -704,6 +770,7 @@ TIERS = [
     ("soak", bench_soak, 900),
     ("overload", bench_overload, 900),
     ("multitenant", bench_multitenant, 900),
+    ("multitenant_sockets", bench_multitenant_sockets, 900),
 ]
 
 # the five BASELINE.json configs (plus companions) recorded every
@@ -712,7 +779,8 @@ TIERS = [
 FULL_TIERS = ("single_verify", "aggregate_verify", "slot_verify",
               "slot_throughput", "slot_pipeline", "stream_verify",
               "htr_registry", "htr_state_warm", "epoch_replay",
-              "epoch_replay_16k", "soak", "overload", "multitenant")
+              "epoch_replay_16k", "soak", "overload", "multitenant",
+              "multitenant_sockets")
 
 
 # --- harness self-test hooks (tests/test_bench_harness.py) ------------------

@@ -173,6 +173,39 @@ _BASE: dict[str, tuple[str, str]] = {
     "stage_coalesce_seconds": (
         HISTOGRAM, "whole-pool coalesce latency (plan + device "
                    "dispatch + recompress)"),
+    # --- wire robustness: connection lifecycle / chaos (PR 15)
+    "wire_accept_refusals": (
+        COUNTER, "connections refused at the accept gate (cap or "
+                 "drain) with RESOURCE_EXHAUSTED/503 + retry hint"),
+    "wire_active_connections": (
+        GAUGE, "live connections registered with a wire server"),
+    "wire_client_breaker_trips": (
+        COUNTER, "client connection-breaker open transitions (dead "
+                 "server degrades to fast explicit drops)"),
+    "wire_client_reconnects": (
+        COUNTER, "client reconnects with jittered backoff (idempotent "
+                 "auto-resend only)"),
+    "wire_conn_clean_closes": (
+        COUNTER, "keep-alive connections ended by clean peer EOF at a "
+                 "frame boundary"),
+    "wire_conn_errors": (
+        COUNTER, "connections torn mid-frame (resets, torn writes, "
+                 "transport errors) — distinct from clean closes"),
+    "wire_connections_closed": (
+        COUNTER, "wire connections unregistered (any cause)"),
+    "wire_connections_opened": (
+        COUNTER, "wire connections admitted past the accept gate"),
+    "wire_drain_fail_closed": (
+        COUNTER, "in-flight requests force-closed at the drain "
+                 "deadline (fail-closed, exact accounting)"),
+    "wire_drained_inflight": (
+        COUNTER, "in-flight requests answered during graceful drain"),
+    "wire_internal_errors": (
+        COUNTER, "unexpected handler exceptions mapped to INTERNAL "
+                 "error frames (connection kept alive)"),
+    "wire_reaps": (
+        COUNTER, "connections reaped by the read deadline (slowloris "
+                 "and dead idle peers)"),
     # --- node / services
     "block_processing_seconds": (
         HISTOGRAM, "per-block processing latency (blockchain service)"),
@@ -232,6 +265,11 @@ BENCH_STAMPED: tuple[str, ...] = (
     "feeder_submits", "feeder_demotions",
     "session_registrations", "session_rejections",
     "pk_obj_cache_evictions",
+    "wire_connections_opened", "wire_connections_closed",
+    "wire_accept_refusals", "wire_reaps", "wire_conn_clean_closes",
+    "wire_conn_errors", "wire_internal_errors",
+    "wire_drained_inflight", "wire_drain_fail_closed",
+    "wire_client_reconnects", "wire_client_breaker_trips",
 )
 
 #: histograms bench.py stamps into each tier's JSON as p50/p90/p99

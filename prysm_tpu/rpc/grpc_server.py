@@ -23,13 +23,17 @@ reused (keep-alive) until either side closes.
 
 from __future__ import annotations
 
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 
 from ..proto import v1alpha1_pb2 as pb
+from ..runtime import faults as _faults
 from .api import APIError, Duty
+from .wire import ConnTracker, shutdown_socket
 
 SERVICE = "/prysm_tpu.v1alpha1.BeaconNodeValidator/"
 
@@ -39,6 +43,7 @@ INVALID_ARGUMENT = 3
 NOT_FOUND = 5
 RESOURCE_EXHAUSTED = 8    # admission rejection: back off and retry
 INTERNAL = 13
+UNAVAILABLE = 14          # client-side breaker open: server unreachable
 
 _MAX_FRAME = 1 << 26          # 64 MiB: a mainnet state fits; junk won't
 
@@ -49,25 +54,86 @@ class RpcError(Exception):
         self.code = code
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+class PeerClosed(ConnectionError):
+    """Clean EOF at a frame boundary: the peer hung up between
+    requests, the normal end of a keep-alive connection."""
+
+
+class FrameTooLarge(ConnectionError):
+    """The peer declared a frame over ``_MAX_FRAME`` — protocol
+    violation; the connection is dropped before buffering it."""
+
+
+class ReadDeadline(OSError):
+    """The per-connection read deadline expired.  ``midframe`` is the
+    slowloris signature: the peer sent PART of a frame and stalled
+    (vs. an idle keep-alive connection that sent nothing at all)."""
+
+    def __init__(self, message: str, midframe: bool = False):
+        super().__init__(message)
+        self.midframe = midframe
+
+
+def _recv_exact(sock: socket.socket, n: int, deadline: float | None = None,
+                at_boundary: bool = False) -> bytes:
     buf = b""
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        if deadline is not None:
+            # ABSOLUTE deadline per frame: each recv gets only the
+            # remaining window, so a 1-byte-per-second slowloris
+            # cannot keep resetting the clock
+            remaining = deadline - time.monotonic()
+            if remaining <= 0.0:
+                raise ReadDeadline(
+                    "read deadline exceeded",
+                    midframe=bool(buf) or not at_boundary)
+            sock.settimeout(remaining)
+        try:
+            chunk = sock.recv(n - len(buf))
+        except TimeoutError:
+            if deadline is None:
+                raise
+            raise ReadDeadline(
+                "read deadline exceeded",
+                midframe=bool(buf) or not at_boundary) from None
         if not chunk:
-            raise ConnectionError("peer closed")
+            if at_boundary and not buf:
+                raise PeerClosed("peer closed")
+            raise ConnectionError("peer closed mid-frame")
         buf += chunk
     return buf
 
 
 def _send_frame(sock: socket.socket, body: bytes) -> None:
-    sock.sendall(struct.pack("<I", len(body)) + body)
+    try:
+        # chaos seams fire inside the REAL send path: a corrupt
+        # wire_frame is an oversize length declaration, a raised
+        # wire_send is a torn write after the header already went out
+        hdr = _faults.fire("wire_frame", struct.pack("<I", len(body)))
+        sock.sendall(hdr)
+        body = _faults.fire("wire_send", body)
+    except _faults.FaultError as e:
+        # an injected wire fault models a peer reset: tear the socket
+        # for real so both ends observe a genuine mid-frame death
+        shutdown_socket(sock)
+        raise ConnectionResetError(f"injected wire fault: {e}") from None
+    sock.sendall(body)
 
 
-def _recv_frame(sock: socket.socket) -> bytes:
-    (total,) = struct.unpack("<I", _recv_exact(sock, 4))
+def _recv_frame(sock: socket.socket,
+                deadline_s: float | None = None) -> bytes:
+    deadline = (None if deadline_s is None
+                else time.monotonic() + deadline_s)
+    try:
+        _faults.fire("wire_recv")
+    except _faults.FaultError as e:
+        shutdown_socket(sock)
+        raise ConnectionResetError(f"injected wire fault: {e}") from None
+    hdr = _recv_exact(sock, 4, deadline=deadline, at_boundary=True)
+    (total,) = struct.unpack("<I", hdr)
     if total > _MAX_FRAME:
-        raise ConnectionError(f"frame too large: {total}")
-    return _recv_exact(sock, total)
+        raise FrameTooLarge(f"frame too large: {total}")
+    return _recv_exact(sock, total, deadline=deadline)
 
 
 class ServiceHandlers:
@@ -189,31 +255,80 @@ class ValidatorRpcServer:
     responses) that grpc's own transport would reject before our code
     sees them."""
 
-    def __init__(self, api, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, api, host: str = "127.0.0.1", port: int = 0, *,
+                 read_deadline_s: float = 30.0,
+                 max_connections: int = 128,
+                 drain_deadline_s: float = 2.0,
+                 refusal_retry_after_s: float = 0.1):
         self.api = api
         self.handlers = ServiceHandlers(api)
         self._handlers = self.handlers.table
+        self.read_deadline_s = float(read_deadline_s)
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.refusal_retry_after_s = float(refusal_retry_after_s)
+        self.tracker = ConnTracker(max_connections)
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                from ..monitoring import flight as _flight
+                from ..monitoring.metrics import metrics as m
                 from ..runtime.admission import client_context
 
                 # per-connection peer identity: the admission
                 # controller's fairness buckets key off it
                 peer = "%s:%s" % self.client_address[:2]
-                try:
-                    with client_context(peer):
-                        while True:
-                            frame = _recv_frame(self.request)
-                            resp = outer._dispatch(frame)
-                            _send_frame(self.request, resp)
-                except (ConnectionError, OSError):
-                    return
+                sock = self.request
+                with client_context(peer):
+                    while not outer.tracker.draining:
+                        try:
+                            frame = _recv_frame(
+                                sock, deadline_s=outer.read_deadline_s)
+                        except PeerClosed:
+                            m.inc("wire_conn_clean_closes")
+                            return
+                        except ReadDeadline as e:
+                            # slowloris / dead client: reap with a
+                            # clean close instead of pinning a thread
+                            m.inc("wire_reaps")
+                            _flight.note("wire_reap", peer=peer,
+                                         midframe=e.midframe)
+                            return
+                        except (ConnectionError, OSError):
+                            if not outer.tracker.draining:
+                                m.inc("wire_conn_errors")
+                            return
+                        outer.tracker.set_busy(sock, True)
+                        try:
+                            resp = outer._dispatch_safe(frame)
+                            # write deadline: a peer that stops
+                            # reading cannot pin the thread in sendall
+                            sock.settimeout(outer.read_deadline_s)
+                            _send_frame(sock, resp)
+                            if outer.tracker.draining:
+                                m.inc("wire_drained_inflight")
+                        except (ConnectionError, OSError):
+                            if not outer.tracker.draining:
+                                m.inc("wire_conn_errors")
+                            return
+                        finally:
+                            outer.tracker.set_busy(sock, False)
+
+            def finish(self):
+                outer.tracker.unregister(self.request)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
+
+            def process_request(self, request, client_address):
+                # the accept gate: refuse over-cap (or mid-drain)
+                # connections INLINE on the accept thread, so handler
+                # threads stay strictly bounded by the cap
+                if not outer.tracker.try_register(request):
+                    outer._refuse(request)
+                    return
+                super().process_request(request, client_address)
 
         self._server = _Server((host, port), _Handler)
         self.host, self.port = self._server.server_address
@@ -227,11 +342,60 @@ class ValidatorRpcServer:
             name="validator-rpc")
         self._thread.start()
 
-    def stop(self) -> None:
-        self._server.shutdown()
+    def stop(self, drain_s: float | None = None) -> None:
+        """Graceful drain: stop accepting, answer every in-flight
+        request (or fail it closed with exact accounting once the
+        drain deadline passes), then close.  Safe to call before
+        ``start()`` or twice (``shutdown()`` would deadlock if
+        ``serve_forever`` never ran)."""
+        # flag first: in-flight work finishing while the accept loop
+        # winds down already counts as drained
+        self.tracker.begin_drain()
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread = None
+        self.tracker.drain(
+            self.drain_deadline_s if drain_s is None else drain_s)
+        self.tracker.close_all()
         self._server.server_close()
 
+    def _refuse(self, request) -> None:
+        """Answer an over-cap connection with RESOURCE_EXHAUSTED and a
+        retry hint (the PR-12 admission vocabulary), then close — no
+        handler thread is ever spawned for it."""
+        from ..monitoring.metrics import metrics as m
+
+        m.inc("wire_accept_refusals")
+        reason = ("draining" if self.tracker.draining
+                  else f"connection cap {self.tracker.cap} reached")
+        try:
+            request.settimeout(1.0)
+            _send_frame(request, self._error(
+                RESOURCE_EXHAUSTED,
+                f"{reason}; retry_after_s={self.refusal_retry_after_s:.3f}"))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            shutdown_socket(request)
+
     # --- dispatch ----------------------------------------------------------
+
+    def _dispatch_safe(self, frame: bytes) -> bytes:
+        """``_dispatch`` maps every expected failure to an error frame
+        already; this wrapper makes the keep-alive guarantee
+        STRUCTURAL — even an error path that itself fails (a message
+        that cannot serialize) still yields an INTERNAL frame instead
+        of a dead connection thread."""
+        try:
+            return self._dispatch(frame)
+        except Exception as e:              # noqa: BLE001
+            from ..monitoring.metrics import metrics as m
+
+            m.inc("wire_internal_errors")
+            try:
+                return self._error(INTERNAL, f"{type(e).__name__}: {e}")
+            except Exception:               # noqa: BLE001
+                return bytes([INTERNAL])
 
     def _dispatch(self, frame: bytes) -> bytes:
         try:
@@ -260,6 +424,12 @@ class ValidatorRpcServer:
         except APIError as e:
             return self._error(INVALID_ARGUMENT, str(e))
         except Exception as e:                  # noqa: BLE001
+            # unexpected handler exception (e.g. an SSZ deserialize
+            # failure): an INTERNAL error frame on the wire, the
+            # connection stays alive, and the escape is counted
+            from ..monitoring.metrics import metrics as m
+
+            m.inc("wire_internal_errors")
             return self._error(INTERNAL, f"{type(e).__name__}: {e}")
 
     @staticmethod
@@ -271,14 +441,36 @@ class ValidatorRpcServer:
 class ValidatorRpcClient:
     """Typed stub mirroring ``ValidatorAPI``'s method signatures, so
     duty-runner code can swap the in-process API for a remote node
-    (the validator-client gRPC stub analog)."""
+    (the validator-client gRPC stub analog).
+
+    Wire hardening: idempotent calls reconnect with capped jittered
+    backoff; mutating calls are NEVER auto-resent (a torn response may
+    mean the server already processed the first attempt).  A
+    per-connection breaker turns a dead server into fast explicit
+    ``RpcError(UNAVAILABLE)`` drops — with a ``retry_after_s`` hint —
+    instead of a connect-timeout hang per call."""
 
     def __init__(self, host: str, port: int, types=None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, *,
+                 reconnect_attempts: int = 2,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 1.0,
+                 breaker_trip_after: int = 3,
+                 breaker_cooldown_s: float = 1.0):
         self._addr = (host, port)
         self._timeout = timeout
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
+        self.reconnect_attempts = int(reconnect_attempts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.breaker_trip_after = int(breaker_trip_after)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self._fail_streak = 0
+        self._open_until = 0.0       # monotonic; > now means open
+        # backoff jitter only — seeded off the address so behavior is
+        # reproducible per endpoint, no wall-clock entropy
+        self._rng = random.Random(hash((host, port)) & 0xFFFFFFFF)
         if types is None:
             from ..proto import active_types
 
@@ -308,28 +500,98 @@ class ValidatorRpcClient:
     })
 
     def _call(self, method: str, req, resp_type):
+        payload = self._request(method, req.SerializeToString())
+        try:
+            return resp_type.FromString(payload)
+        except Exception as e:              # noqa: BLE001
+            # a corrupted-but-well-framed response (chaos wire_send
+            # corrupt mode, buggy middlebox) surfaces as a typed
+            # protocol error, never a DecodeError up the duty runner
+            raise RpcError(
+                INTERNAL,
+                f"undecodable response payload: {type(e).__name__}",
+            ) from None
+
+    def call_raw(self, method: str, payload: bytes = b"") -> bytes:
+        """Transport escape hatch for extension methods registered in
+        the server's handler table (the sockets-mode storm harness):
+        full wire semantics — framing, status codes, breaker — with
+        raw payload bytes.  Methods not in ``_IDEMPOTENT`` get
+        mutating semantics (never auto-resent)."""
+        return self._request(method, payload)
+
+    def _request(self, method: str, payload: bytes) -> bytes:
         body = (struct.pack("<H", len(SERVICE + method))
                 + (SERVICE + method).encode()
-                + req.SerializeToString())
+                + payload)
         with self._lock:
-            try:
-                resp = self._roundtrip(body)
-            except (ConnectionError, OSError):
-                if method not in self._IDEMPOTENT:
-                    raise
-                # one reconnect: the server may have dropped an idle
-                # keep-alive connection
-                resp = self._roundtrip(body)
+            resp = self._exchange(method, body)
         if not resp:
             # a zero-length response frame (buggy/hostile server) must
             # surface through the protocol's typed error path, not as
             # an IndexError
             raise RpcError(INTERNAL, "empty response frame")
-        status, payload = resp[0], resp[1:]
+        status, body = resp[0], resp[1:]
         if status != OK:
-            err = pb.Error.FromString(payload)
-            raise RpcError(err.code or status, err.message)
-        return resp_type.FromString(payload)
+            try:
+                err = pb.Error.FromString(body)
+                code, message = err.code or status, err.message
+            except Exception:               # noqa: BLE001
+                code, message = status, "undecodable error frame"
+            raise RpcError(code, message)
+        return body
+
+    def _exchange(self, method: str, body: bytes) -> bytes:
+        """One logical exchange: breaker gate, then send/recv with
+        capped jittered backoff reconnects for idempotent methods."""
+        idempotent = method in self._IDEMPOTENT
+        self._breaker_gate()
+        attempt = 0
+        while True:
+            try:
+                resp = self._roundtrip(body)
+            except (ConnectionError, OSError):
+                self._breaker_failure()
+                if not idempotent or attempt >= self.reconnect_attempts:
+                    raise
+                attempt += 1
+                from ..monitoring.metrics import metrics as m
+
+                m.inc("wire_client_reconnects")
+                delay = min(self.backoff_cap_s,
+                            self.backoff_base_s * (2 ** (attempt - 1)))
+                time.sleep(delay * (0.5 + 0.5 * self._rng.random()))
+                continue
+            self._breaker_success()
+            return resp
+
+    # --- per-connection breaker --------------------------------------------
+
+    def _breaker_gate(self) -> None:
+        now = time.monotonic()
+        if self._open_until > now:
+            raise RpcError(
+                UNAVAILABLE,
+                "connection breaker open; "
+                f"retry_after_s={self._open_until - now:.3f}")
+
+    def _breaker_failure(self) -> None:
+        self._fail_streak += 1
+        if self._fail_streak >= self.breaker_trip_after:
+            was_open = self._open_until > time.monotonic()
+            self._open_until = time.monotonic() + self.breaker_cooldown_s
+            if not was_open:
+                from ..monitoring import flight as _flight
+                from ..monitoring.metrics import metrics as m
+
+                m.inc("wire_client_breaker_trips")
+                _flight.note("wire_breaker_trip",
+                             addr="%s:%s" % self._addr,
+                             streak=self._fail_streak)
+
+    def _breaker_success(self) -> None:
+        self._fail_streak = 0
+        self._open_until = 0.0
 
     def _roundtrip(self, body: bytes) -> bytes:
         """One send/recv; ANY transport error poisons the connection

@@ -27,6 +27,7 @@ from ..blockchain.events import EVENT_BLOCK, EVENT_FINALIZED, EVENT_HEAD
 from ..proto import Attestation
 from .api import APIError
 from .beacon_api import BeaconAPI
+from .wire import ConnTracker, shutdown_socket
 
 # malformed client input (missing params, bad hex/SSZ, bad slot) maps
 # to 400 per Beacon-API convention; anything else is a true 500
@@ -52,18 +53,49 @@ def _jsonable(obj):
 
 
 class BeaconHTTPServer:
-    """Serves node status, duties, attestation data, submissions."""
+    """Serves node status, duties, attestation data, submissions.
+
+    Wire hardening (shared vocabulary with the framed carrier): a
+    per-connection read timeout (stdlib ``StreamRequestHandler`` honors
+    the handler ``timeout`` attribute — an HTTP slowloris times out in
+    ``readline`` and is reaped), a connection cap answered inline with
+    503 + Retry-After before any handler thread spawns, and graceful
+    drain on ``stop()`` through the same :class:`ConnTracker` ledger.
+    ``extra_routes`` maps a POST path to ``fn(handler, body)`` — the
+    extension point harnesses use to ride the real HTTP wire without
+    polluting the Beacon API surface."""
 
     def __init__(self, node, api, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, *, read_deadline_s: float = 30.0,
+                 max_connections: int = 128,
+                 drain_deadline_s: float = 2.0):
         self.node = node
         self.api = api
         self.beacon = BeaconAPI(node, validator_api=api)
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.tracker = ConnTracker(max_connections)
+        self.extra_routes: dict = {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            timeout = float(read_deadline_s)
+
             def log_message(self, fmt, *args):   # quiet test output
                 pass
+
+            def log_error(self, fmt, *args):
+                # stdlib routes request-line read timeouts here
+                # before closing: that IS the slowloris reap
+                if "timed out" in fmt:
+                    from ..monitoring.metrics import metrics as m
+
+                    m.inc("wire_reaps")
+
+            def finish(self):
+                try:
+                    super().finish()
+                finally:
+                    outer.tracker.unregister(self.connection)
 
             def _send(self, code: int, body,
                       content_type="application/json", headers=()):
@@ -78,19 +110,45 @@ class BeaconHTTPServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _try_send(self, code: int, body, headers=()):
+                """Error-path send: the peer may already be gone —
+                swallow the transport failure, count it, close."""
+                try:
+                    self._send(code, body, headers=headers)
+                except (ConnectionError, OSError):
+                    from ..monitoring.metrics import metrics as m
+
+                    m.inc("wire_conn_errors")
+                    self.close_connection = True
+
             def do_GET(self):
+                from ..monitoring.metrics import metrics as m
+
+                outer.tracker.set_busy(self.connection, True)
                 try:
                     outer._handle_get(self)
+                except TimeoutError:
+                    # stalled mid-request (slowloris body): reap
+                    m.inc("wire_reaps")
+                    self.close_connection = True
+                except (ConnectionError, OSError):
+                    m.inc("wire_conn_errors")
+                    self.close_connection = True
                 except _CLIENT_ERRORS as e:
-                    self._send(400, {"error": repr(e)})
+                    self._try_send(400, {"error": repr(e)})
                 except Exception as e:  # noqa: BLE001
-                    self._send(500, {"error": repr(e)})
+                    m.inc("wire_internal_errors")
+                    self._try_send(500, {"error": repr(e)})
+                finally:
+                    outer.tracker.set_busy(self.connection, False)
 
             def do_POST(self):
+                from ..monitoring.metrics import metrics as m
                 from ..runtime.admission import (
                     AdmissionRejected, client_context,
                 )
 
+                outer.tracker.set_busy(self.connection, True)
                 try:
                     with client_context(self.client_address[0]):
                         outer._handle_post(self)
@@ -98,17 +156,58 @@ class BeaconHTTPServer:
                     # REST backpressure: 429 + Retry-After (whole
                     # seconds, ceil) + the precise hint in the body
                     retry = max(1, math.ceil(e.retry_after_s))
-                    self._send(429, {"error": str(e),
-                                     "retry_after_s": e.retry_after_s},
-                               headers=(("Retry-After", str(retry)),))
+                    self._try_send(
+                        429, {"error": str(e),
+                              "retry_after_s": e.retry_after_s},
+                        headers=(("Retry-After", str(retry)),))
+                except TimeoutError:
+                    m.inc("wire_reaps")
+                    self.close_connection = True
+                except (ConnectionError, OSError):
+                    m.inc("wire_conn_errors")
+                    self.close_connection = True
                 except _CLIENT_ERRORS as e:
-                    self._send(400, {"error": repr(e)})
+                    self._try_send(400, {"error": repr(e)})
                 except Exception as e:  # noqa: BLE001
-                    self._send(500, {"error": repr(e)})
+                    m.inc("wire_internal_errors")
+                    self._try_send(500, {"error": repr(e)})
+                finally:
+                    outer.tracker.set_busy(self.connection, False)
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            def process_request(self, request, client_address):
+                # accept gate: over-cap connections are answered 503
+                # inline on the accept thread — handler threads stay
+                # bounded by the cap
+                if not outer.tracker.try_register(request):
+                    outer._refuse(request)
+                    return
+                super().process_request(request, client_address)
+
+        self._server = _Server((host, port), Handler)
         self.port = self._server.server_port
         self._thread: threading.Thread | None = None
+
+    def _refuse(self, request) -> None:
+        from ..monitoring.metrics import metrics as m
+
+        m.inc("wire_accept_refusals")
+        reason = ("draining" if self.tracker.draining
+                  else "connection cap reached")
+        body = json.dumps({"error": reason,
+                           "retry_after_s": 0.1}).encode()
+        resp = (b"HTTP/1.1 503 Service Unavailable\r\n"
+                b"Retry-After: 1\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"Connection: close\r\n\r\n" + body)
+        try:
+            request.settimeout(1.0)
+            request.sendall(resp)
+        except OSError:
+            pass
+        finally:
+            shutdown_socket(request)
 
     # --- routes ------------------------------------------------------------
 
@@ -271,6 +370,10 @@ class BeaconHTTPServer:
             h.send_header("Content-Type", "text/event-stream")
             h.send_header("Cache-Control", "no-cache")
             h.end_headers()
+            # headers out = the request is ANSWERED; the open stream
+            # must not hold up a graceful drain, so mark the
+            # connection idle (drain closes it like any idle conn)
+            self.tracker.set_busy(h.connection, False)
             while not getattr(self, "_shutdown", False):
                 try:
                     topic, payload = q.get(timeout=1.0)
@@ -329,6 +432,8 @@ class BeaconHTTPServer:
                    f"-{next(_backup_seq)}")
             self.node.db.store.backup(dst)
             h._send(200, {"backup": dst})
+        elif h.path in self.extra_routes:
+            self.extra_routes[h.path](h, body)
         else:
             h._send(404, {"error": f"no route {h.path}"})
 
@@ -339,9 +444,17 @@ class BeaconHTTPServer:
             target=self._server.serve_forever, daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float | None = None) -> None:
         self._shutdown = True        # ends any open SSE streams <=1s
-        self._server.shutdown()
+        self.tracker.begin_drain()   # flag first: late responses count
+        if self._thread:             # shutdown() deadlocks pre-start
+            self._server.shutdown()  # stop accepting
+        # graceful drain: in-flight requests get answered (or
+        # fail-closed with exact accounting), idle keep-alives and
+        # SSE streams are shut down immediately
+        self.tracker.drain(
+            self.drain_deadline_s if drain_s is None else drain_s)
+        self.tracker.close_all()
         self._server.server_close()
         if self._thread:
             self._thread.join(timeout=2.0)

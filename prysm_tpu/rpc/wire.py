@@ -1,0 +1,174 @@
+"""Connection-lifecycle bookkeeping shared by the wire carriers.
+
+Reference analog: the connection manager grpc-go embeds under every
+server — accept caps, keep-alive enforcement, and GracefulStop's
+drain — which Prysm inherits for free [U, SURVEY.md §2 "RPC"].  Our
+framed-TCP fallback (``grpc_server``) and the Beacon HTTP server
+(``http_server``) are hand-rolled on ``socketserver``, so the same
+lifecycle guarantees live here and both carriers share them:
+
+* **Bounded concurrency** — :meth:`ConnTracker.try_register` is the
+  accept gate: it refuses registration at the cap (or while
+  draining), BEFORE a handler thread is spawned, so handler threads
+  are strictly bounded by ``cap``.  The carrier answers the refused
+  socket inline on the accept thread (RESOURCE_EXHAUSTED / 503 with a
+  retry hint, riding the PR-12 admission vocabulary) and closes it.
+
+* **Graceful drain** — :meth:`ConnTracker.drain` stops the world in
+  exact-accounting order: idle connections (blocked in a read, no
+  request in flight) are shut down immediately; busy connections get
+  until the drain deadline to answer; stragglers are force-closed
+  fail-closed and counted (``wire_drain_fail_closed``).  Nothing is
+  silently abandoned.
+
+* **Churn visibility** — every open/close moves the
+  ``wire_connections_opened/closed`` counters and the
+  ``wire_active_connections`` gauge, so slowloris reaping, chaos
+  resets, and reconnect storms all render in the same ``/metrics``
+  text a production scrape sees.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+
+def _metrics():
+    from ..monitoring.metrics import metrics
+
+    return metrics
+
+
+def shutdown_socket(sock) -> None:
+    """Tear a socket hard enough to wake a thread blocked in recv on
+    it (``close`` alone does not reliably interrupt a blocked read —
+    ``shutdown`` delivers EOF first)."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class _Conn:
+    __slots__ = ("sock", "busy")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.busy = False
+
+
+class ConnTracker:
+    """Registry of live connections for one server: the accept gate,
+    the busy/idle ledger the drain consults, and the churn counters."""
+
+    def __init__(self, cap: int):
+        self.cap = int(cap)
+        self._lock = threading.Lock()
+        self._conns: dict[int, _Conn] = {}
+        self.draining = False
+        # register the churn counters at zero so the wire's state is
+        # scrape-visible before the first connection
+        m = _metrics()
+        for c in ("wire_connections_opened", "wire_connections_closed",
+                  "wire_accept_refusals", "wire_reaps",
+                  "wire_conn_clean_closes", "wire_conn_errors",
+                  "wire_drained_inflight", "wire_drain_fail_closed"):
+            m.inc(c, 0)
+        m.set("wire_active_connections", 0)
+
+    # --- accept gate -------------------------------------------------------
+
+    def try_register(self, sock) -> bool:
+        """Admit one connection; False means refuse (cap or draining).
+        Called on the ACCEPT thread, before any handler thread exists,
+        so a False here is a connection that never cost a thread."""
+        with self._lock:
+            if self.draining or len(self._conns) >= self.cap:
+                return False
+            self._conns[id(sock)] = _Conn(sock)
+            n = len(self._conns)
+        m = _metrics()
+        m.inc("wire_connections_opened")
+        m.set("wire_active_connections", n)
+        return True
+
+    def unregister(self, sock) -> None:
+        with self._lock:
+            gone = self._conns.pop(id(sock), None)
+            n = len(self._conns)
+        if gone is not None:
+            m = _metrics()
+            m.inc("wire_connections_closed")
+            m.set("wire_active_connections", n)
+
+    def set_busy(self, sock, busy: bool) -> None:
+        """Mark a request in flight on this connection: received in
+        full, response not yet written.  The drain's exact accounting
+        keys off this flag."""
+        with self._lock:
+            c = self._conns.get(id(sock))
+            if c is not None:
+                c.busy = busy
+
+    def active(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
+    # --- graceful drain ----------------------------------------------------
+
+    def begin_drain(self) -> None:
+        """Raise the draining flag WITHOUT waiting: new connections
+        are refused from this instant, and any response completed
+        after it counts as drained in-flight work.  Carriers call
+        this before stopping their accept loop so the flag is already
+        up while the loop winds down."""
+        with self._lock:
+            self.draining = True
+
+    def drain(self, deadline_s: float, poll_s: float = 0.005) -> dict:
+        """Stop-the-world with exact accounting: close idle
+        connections now (their handlers wake with EOF and exit), wait
+        up to ``deadline_s`` for busy ones to answer their in-flight
+        request, then force-close the stragglers fail-closed.  Returns
+        ``{"fail_closed": n, "waited_s": t}``."""
+        with self._lock:
+            self.draining = True
+        t0 = time.monotonic()
+        deadline = t0 + deadline_s
+        while True:
+            with self._lock:
+                idle = [c.sock for c in self._conns.values() if not c.busy]
+                n_busy = sum(1 for c in self._conns.values() if c.busy)
+            for s in idle:
+                shutdown_socket(s)
+            if n_busy == 0 or time.monotonic() >= deadline:
+                break
+            time.sleep(poll_s)
+        with self._lock:
+            leftovers = [c.sock for c in self._conns.values() if c.busy]
+        m = _metrics()
+        for s in leftovers:
+            # an in-flight request we could not answer in time: the
+            # peer sees a hard close, never a silent hang
+            m.inc("wire_drain_fail_closed")
+            shutdown_socket(s)
+        waited = time.monotonic() - t0
+        from ..monitoring import flight as _flight
+
+        _flight.note("wire_drain", fail_closed=len(leftovers),
+                     waited_ms=round(waited * 1000.0, 3))
+        return {"fail_closed": len(leftovers), "waited_s": waited}
+
+    def close_all(self) -> None:
+        """Post-drain sweep: tear whatever is still registered (idle
+        handlers that have not yet woken and unregistered)."""
+        with self._lock:
+            socks = [c.sock for c in self._conns.values()]
+        for s in socks:
+            shutdown_socket(s)

@@ -37,6 +37,18 @@ module is both halves of that story:
                         mode returns a payload whose conversion raises
                         (a short DMA that delivered only part of the
                         buffer), classified transient like ``readback``
+  ``wire_frame``        the 4-byte length prefix of an outgoing frame
+                        (``rpc.grpc_server._send_frame``): corrupt mode
+                        replaces it with an oversize declaration
+                        (> ``_MAX_FRAME``), so the peer rejects the
+                        frame and drops the connection
+  ``wire_send``         an outgoing frame body AFTER its header went
+                        out: raise mode is a torn write / connection
+                        reset mid-frame (the wire layer tears the
+                        socket for real); corrupt mode flips one byte
+  ``wire_recv``         an incoming frame read: raise mode is a peer
+                        reset before the frame; delay mode is a
+                        stalled read (what the read deadline reaps)
   ====================  ===================================================
 
   Install via the ``PRYSM_TPU_FAULTS`` env var (read once at import)
@@ -76,7 +88,8 @@ import time
 from contextlib import contextmanager
 
 _POINTS = ("device_dispatch", "readback", "pubkey_sync", "h2c_pack",
-           "backend_select", "device_buffer", "partial_readback")
+           "backend_select", "device_buffer", "partial_readback",
+           "wire_frame", "wire_send", "wire_recv")
 
 
 class FaultError(RuntimeError):
@@ -120,12 +133,27 @@ def _corrupt_limb(payload):
     return arr
 
 
+def _corrupt_wire_bytes(payload):
+    """corrupt-mode wire payload: flip one byte of the frame.  For a
+    response frame byte 0 is the status byte, so the peer sees a
+    well-framed but semantically garbage answer — exactly the shape a
+    buggy middlebox produces."""
+    if not payload:
+        raise FaultError("injected wire corruption (empty frame)")
+    b = bytearray(payload)
+    b[0] ^= 0x01
+    return bytes(b)
+
+
 # corrupt-mode payload transforms per point; points without one raise
 _CORRUPTORS = {
     "backend_select": lambda payload: "pure",
     "readback": lambda payload: _CorruptedReadback(),
     "device_buffer": _corrupt_limb,
     "partial_readback": lambda payload: _TruncatedReadback(),
+    # oversize length declaration: 128 MiB > the 64 MiB _MAX_FRAME cap
+    "wire_frame": lambda payload: (1 << 27).to_bytes(4, "little"),
+    "wire_send": _corrupt_wire_bytes,
 }
 
 

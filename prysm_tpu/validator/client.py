@@ -33,6 +33,12 @@ from .protection import ProtectionError, SlashingProtectionDB
 #: transport-agnostic)
 _RESOURCE_EXHAUSTED = 8
 
+#: gRPC UNAVAILABLE — the client-side connection breaker failing fast
+#: on a dead server.  The server never SAW the submission, so a
+#: bounded resend is safe; the breaker message carries the cooldown
+#: as a ``retry_after_s`` hint.
+_UNAVAILABLE = 14
+
 
 class ValidatorClient:
     def __init__(self, api, keymanager: KeyManager,
@@ -73,6 +79,12 @@ class ValidatorClient:
         if isinstance(e, AdmissionRejected):
             return e.retry_after_s
         if getattr(e, "code", None) == _RESOURCE_EXHAUSTED:
+            hinted = retry_after_from(str(e))
+            return hinted if hinted is not None else 0.1
+        if getattr(e, "code", None) == _UNAVAILABLE:
+            # breaker fail-fast: the request never left this process,
+            # so resending cannot double-submit; wait out the hinted
+            # cooldown (or a small default) before the retry
             hinted = retry_after_from(str(e))
             return hinted if hinted is not None else 0.1
         return None

@@ -20,7 +20,9 @@ every signature over HTTP.
 from __future__ import annotations
 
 import json
+import random as _random
 import threading
+import time as _time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -97,11 +99,22 @@ class RemoteSignerError(Exception):
 class RemoteKeyManager:
     """KeyManager-compatible facade whose ``sign`` round-trips to a
     remote signer; pubkeys are fetched once at construction (the
-    remote signer owns key lifecycle)."""
+    remote signer owns key lifecycle).
 
-    def __init__(self, url: str, timeout: float = 10.0):
+    Wire hardening: signing is a PURE function of (key, root), so a
+    transport failure (connection refused/reset, timeout) is safe to
+    resend — ``sign`` retries with capped jittered backoff.  HTTP
+    error RESPONSES (400/404) are definitive answers, never retried."""
+
+    def __init__(self, url: str, timeout: float = 10.0, *,
+                 retries: int = 2, backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 1.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        self.retries = int(retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self._rng = _random.Random(hash(self.url) & 0xFFFFFFFF)
         self._pubkeys = [
             bytes.fromhex(k.removeprefix("0x"))
             for k in self._get(f"{_PREFIX}/publicKeys")]
@@ -123,11 +136,31 @@ class RemoteKeyManager:
         req = urllib.request.Request(
             f"{self.url}{_PREFIX}/sign/0x{pubkey.hex()}", data=body,
             headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                resp = json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            raise RemoteSignerError(
-                f"signer returned {e.code}: {e.read()[:200]!r}") from None
+        attempt = 0
+        while True:
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as r:
+                    resp = json.loads(r.read())
+                break
+            except urllib.error.HTTPError as e:
+                # a definitive signer answer (unknown key, malformed
+                # request): never resent
+                raise RemoteSignerError(
+                    f"signer returned {e.code}: "
+                    f"{e.read()[:200]!r}") from None
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    TimeoutError) as e:
+                if attempt >= self.retries:
+                    raise RemoteSignerError(
+                        f"signer unreachable after "
+                        f"{attempt + 1} attempts: {e}") from None
+                attempt += 1
+                from ..monitoring.metrics import metrics as _m
+
+                _m.inc("wire_client_reconnects")
+                delay = min(self.backoff_cap_s,
+                            self.backoff_base_s * (2 ** (attempt - 1)))
+                _time.sleep(delay * (0.5 + 0.5 * self._rng.random()))
         return bls.Signature.from_bytes(
             bytes.fromhex(resp["signature"].removeprefix("0x")))

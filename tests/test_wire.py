@@ -1,0 +1,700 @@
+"""Wire robustness: connection-lifecycle hardening under socket chaos.
+
+Every test here drives a LIVE framed-TCP or HTTP server through a real
+socket — slowloris half-frames, malformed frames, connection caps,
+graceful drain, client reconnect/breaker behavior — and asserts the
+declared ``wire_*`` counters move exactly as the contract says.  The
+servers run with sub-second read deadlines so the whole matrix stays
+tier-1 fast."""
+
+import socket
+import struct
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from prysm_tpu.monitoring.metrics import metrics
+from prysm_tpu.proto import v1alpha1_pb2 as pb
+from prysm_tpu.rpc.grpc_server import (
+    INTERNAL, NOT_FOUND, RESOURCE_EXHAUSTED, SERVICE, UNAVAILABLE,
+    RpcError, ValidatorRpcClient, ValidatorRpcServer, _recv_frame,
+)
+from prysm_tpu.rpc.http_server import BeaconHTTPServer
+from prysm_tpu.runtime.admission import (
+    AdmissionRejected, retry_after_from,
+)
+from prysm_tpu.runtime.scenarios import FlappingClient, SlowlorisSwarm
+
+
+def _counter(name: str) -> float:
+    return metrics.counter(name).value
+
+
+def _frame(method: str, payload: bytes = b"") -> bytes:
+    name = (SERVICE + method).encode()
+    body = struct.pack("<H", len(name)) + name + payload
+    return struct.pack("<I", len(body)) + body
+
+
+def _wait_for(cond, timeout_s: float = 3.0, interval_s: float = 0.01):
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        if cond():
+            return True
+        time.sleep(interval_s)
+    return cond()
+
+
+@pytest.fixture()
+def server():
+    """A framed server over a stub API with extension handlers:
+    Echo (returns Empty), Boom (raises), Slow (0.4s), Hang (5s)."""
+    srv = ValidatorRpcServer(
+        SimpleNamespace(), read_deadline_s=0.3, max_connections=4,
+        drain_deadline_s=1.0)
+    srv.calls = {"Echo": 0, "Boom": 0, "Slow": 0, "Hang": 0}
+
+    def _mk(name, delay=0.0, boom=False):
+        def h(payload):
+            srv.calls[name] += 1
+            if delay:
+                time.sleep(delay)
+            if boom:
+                raise ValueError("kaput")
+            return pb.Empty()
+        return h
+
+    srv.handlers.table["Echo"] = _mk("Echo")
+    srv.handlers.table["Boom"] = _mk("Boom", boom=True)
+    srv.handlers.table["Slow"] = _mk("Slow", delay=0.4)
+    srv.handlers.table["Hang"] = _mk("Hang", delay=5.0)
+    srv.start()
+    yield srv
+    srv.stop(drain_s=0.5)
+
+
+def _client(srv, **kw) -> ValidatorRpcClient:
+    kw.setdefault("timeout", 5.0)
+    kw.setdefault("backoff_base_s", 0.01)
+    return ValidatorRpcClient(srv.host, srv.port,
+                              types=SimpleNamespace(), **kw)
+
+
+def _connect(srv) -> socket.socket:
+    return socket.create_connection((srv.host, srv.port), timeout=5.0)
+
+
+def _dead(sock: socket.socket, timeout_s: float = 3.0) -> bool:
+    """True once the SERVER closed this socket (EOF or reset)."""
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        sock.settimeout(max(0.02, end - time.monotonic()))
+        try:
+            if sock.recv(256) == b"":
+                return True
+        except TimeoutError:
+            return False
+        except OSError:
+            return True
+    return False
+
+
+class TestReadDeadlines:
+    def test_slowloris_reaped_within_deadline(self, server):
+        """The acceptance gate: a half-sent frame held open dies by
+        the read deadline with a clean close, counted as a reap."""
+        reaps = _counter("wire_reaps")
+        s = _connect(server)
+        s.sendall(b"\x10")                      # 1 of 4 header bytes
+        t0 = time.monotonic()
+        assert _dead(s, server.read_deadline_s * 3 + 1)
+        assert time.monotonic() - t0 <= server.read_deadline_s * 3 + 1
+        assert _counter("wire_reaps") == reaps + 1
+        s.close()
+        assert _wait_for(lambda: server.tracker.active() == 0)
+
+    def test_idle_connection_reaped(self, server):
+        reaps = _counter("wire_reaps")
+        s = _connect(server)                    # never sends a byte
+        assert _dead(s, server.read_deadline_s * 3 + 1)
+        assert _counter("wire_reaps") == reaps + 1
+        s.close()
+
+    def test_deadline_is_absolute_not_per_recv(self, server):
+        """Dripping one byte per 0.1s must NOT reset the clock — the
+        deadline is fixed at frame start."""
+        s = _connect(server)
+        t0 = time.monotonic()
+        died = False
+        for b in struct.pack("<I", 64) + b"\x00" * 60:
+            try:
+                s.sendall(bytes([b]))
+            except OSError:
+                died = True
+                break
+            if _dead(s, 0.1):
+                died = True
+                break
+        assert died, "drip-feed kept the connection alive"
+        # 64 drips at 0.1s would be 6.4s if each recv reset the clock
+        assert time.monotonic() - t0 < server.read_deadline_s * 4 + 1
+        s.close()
+
+    def test_slowloris_swarm_scenario(self, server):
+        swarm = SlowlorisSwarm(server.host, server.port, n=3, seed=7)
+        assert swarm.open() == 3
+        assert swarm.reaped_within(server.read_deadline_s * 3 + 1)
+        swarm.close()
+
+
+class TestMalformedFrames:
+    """The malformed-frame matrix: every shape is either answered
+    with a typed error frame or closed cleanly — never a leaked
+    handler thread."""
+
+    def test_truncated_length_prefix(self, server):
+        errors = _counter("wire_conn_errors")
+        s = _connect(server)
+        s.sendall(b"\xff\xff")                  # 2 of 4 header bytes
+        s.close()                               # die mid-frame
+        assert _wait_for(
+            lambda: _counter("wire_conn_errors") == errors + 1)
+        assert _wait_for(lambda: server.tracker.active() == 0)
+
+    def test_oversize_declaration_drops_connection(self, server):
+        s = _connect(server)
+        s.sendall(struct.pack("<I", 1 << 30))   # over _MAX_FRAME
+        assert _dead(s)                         # dropped, not buffered
+        s.close()
+        assert _wait_for(lambda: server.tracker.active() == 0)
+
+    def test_garbage_method_answered_conn_alive(self, server):
+        s = _connect(server)
+        s.sendall(_frame("NoSuchMethod"))
+        resp = _recv_frame(s)
+        assert resp[0] == NOT_FOUND
+        # the SAME connection still serves a good call
+        s.sendall(_frame("Echo"))
+        assert _recv_frame(s)[0] == 0
+        assert server.calls["Echo"] == 1
+        s.close()
+
+    def test_empty_frame_answered_not_crash(self, server):
+        s = _connect(server)
+        s.sendall(struct.pack("<I", 0))         # zero-length frame
+        resp = _recv_frame(s)
+        assert resp[0] != 0                     # typed error, no hang
+        s.sendall(_frame("Echo"))               # conn still alive
+        assert _recv_frame(s)[0] == 0
+        s.close()
+
+    def test_torn_write_then_reconnect(self, server):
+        good = _frame("Echo")
+        s = _connect(server)
+        s.sendall(good[: len(good) // 2])
+        s.close()                               # torn mid-frame
+        s2 = _connect(server)                   # fresh socket works
+        s2.sendall(good)
+        assert _recv_frame(s2)[0] == 0
+        s2.close()
+        assert _wait_for(lambda: server.tracker.active() == 0)
+
+    def test_no_thread_leak_across_matrix(self, server):
+        base = threading.active_count()
+        for _ in range(6):
+            s = _connect(server)
+            s.sendall(b"\x01")
+            s.close()
+        assert _wait_for(lambda: server.tracker.active() == 0)
+        assert _wait_for(
+            lambda: threading.active_count() <= base + 1)
+
+
+class TestConnectionCap:
+    def test_over_cap_refused_with_retry_hint(self):
+        srv = ValidatorRpcServer(
+            SimpleNamespace(), read_deadline_s=5.0,
+            max_connections=2, refusal_retry_after_s=0.25)
+        srv.handlers.table["Echo"] = lambda p: pb.Empty()
+        srv.start()
+        try:
+            refusals = _counter("wire_accept_refusals")
+            held = [_connect(srv), _connect(srv)]
+            # ensure both are registered before the third connect
+            for s in held:
+                s.sendall(_frame("Echo"))
+                assert _recv_frame(s)[0] == 0
+            extra = _connect(srv)
+            resp = _recv_frame(extra)
+            assert resp[0] == RESOURCE_EXHAUSTED
+            err = pb.Error.FromString(resp[1:])
+            assert "connection cap" in err.message
+            assert retry_after_from(err.message) == 0.25
+            assert _dead(extra)                 # refused conns close
+            assert _counter("wire_accept_refusals") == refusals + 1
+            assert srv.tracker.active() <= 2
+            # freeing a slot readmits
+            held[0].close()
+            assert _wait_for(lambda: srv.tracker.active() < 2)
+            s = _connect(srv)
+            s.sendall(_frame("Echo"))
+            assert _recv_frame(s)[0] == 0
+            for s2 in (held[1], s, extra):
+                s2.close()
+        finally:
+            srv.stop(drain_s=0.5)
+
+
+class TestGracefulDrain:
+    def test_drain_answers_inflight(self, server):
+        drained = _counter("wire_drained_inflight")
+        failed = _counter("wire_drain_fail_closed")
+        result = {}
+
+        def call():
+            c = _client(server)
+            try:
+                c.call_raw("Slow")              # 0.4s handler
+                result["ok"] = True
+            except Exception as e:              # noqa: BLE001
+                result["err"] = e
+            finally:
+                c.close()
+
+        t = threading.Thread(target=call)
+        t.start()
+        assert _wait_for(lambda: server.calls["Slow"] == 1)
+        server.stop(drain_s=3.0)                # drain must wait
+        t.join(timeout=5.0)
+        assert result.get("ok"), result
+        assert _counter("wire_drained_inflight") == drained + 1
+        assert _counter("wire_drain_fail_closed") == failed
+
+    def test_drain_deadline_fails_closed_with_accounting(self, server):
+        failed = _counter("wire_drain_fail_closed")
+        result = {}
+
+        def call():
+            c = _client(server)
+            try:
+                c.call_raw("Hang")              # 5s handler
+                result["ok"] = True
+            except Exception as e:              # noqa: BLE001
+                result["err"] = e
+            finally:
+                c.close()
+
+        t = threading.Thread(target=call, daemon=True)
+        t.start()
+        assert _wait_for(lambda: server.calls["Hang"] == 1)
+        t0 = time.monotonic()
+        server.stop(drain_s=0.3)                # can't wait 5s
+        assert time.monotonic() - t0 < 2.0      # bounded, not hung
+        assert _counter("wire_drain_fail_closed") == failed + 1
+        t.join(timeout=3.0)
+        assert "ok" not in result               # failed CLOSED
+
+    def test_refused_while_draining(self, server):
+        # a connection arriving mid-drain is refused, not accepted
+        hold = _connect(server)
+        hold.sendall(_frame("Slow"))
+        done = threading.Event()
+
+        def stopper():
+            server.stop(drain_s=2.0)
+            done.set()
+
+        t = threading.Thread(target=stopper)
+        assert _wait_for(lambda: server.calls["Slow"] == 1)
+        t.start()
+        assert _wait_for(lambda: server.tracker.draining)
+        try:
+            # mid-drain the accept loop is already stopped, so a late
+            # connection is either refused with a typed "draining"
+            # frame or torn down when the listener closes — it must
+            # never be silently accepted and serviced
+            late = _connect(server)
+            late.settimeout(5.0)
+            resp = _recv_frame(late)
+            err = pb.Error.FromString(resp[1:])
+            assert resp[0] == RESOURCE_EXHAUSTED
+            assert "draining" in err.message
+            late.close()
+        except (ConnectionError, OSError):
+            pass                                # listener already gone
+        assert _recv_frame(hold)[0] == 0        # in-flight answered
+        hold.close()
+        t.join(timeout=5.0)
+        assert done.is_set()
+
+
+class TestDispatchHardening:
+    def test_handler_exception_maps_to_internal_conn_alive(
+            self, server):
+        """Satellite: an unexpected handler exception becomes an
+        INTERNAL error frame, the connection survives, the escape is
+        counted."""
+        internals = _counter("wire_internal_errors")
+        opened = _counter("wire_connections_opened")
+        c = _client(server)
+        with pytest.raises(RpcError) as ei:
+            c.call_raw("Boom")
+        assert ei.value.code == INTERNAL
+        assert "ValueError" in str(ei.value)
+        assert _counter("wire_internal_errors") == internals + 1
+        # the same connection serves the next call — no reconnect
+        c.call_raw("Echo")
+        assert server.calls["Echo"] == 1
+        assert _counter("wire_connections_opened") == opened + 1
+        c.close()
+
+    def test_clean_close_vs_midframe_death_counted(self, server):
+        """Satellite: a peer hanging up between frames is a CLEAN
+        close; dying mid-frame is a connection error — distinct
+        counters, visible to the flight recorder."""
+        clean = _counter("wire_conn_clean_closes")
+        errors = _counter("wire_conn_errors")
+        s = _connect(server)
+        s.sendall(_frame("Echo"))
+        assert _recv_frame(s)[0] == 0
+        s.close()                               # polite EOF at boundary
+        assert _wait_for(
+            lambda: _counter("wire_conn_clean_closes") == clean + 1)
+        assert _counter("wire_conn_errors") == errors
+        s2 = _connect(server)
+        s2.sendall(b"\x07\x00")                 # die mid-header
+        s2.close()
+        assert _wait_for(
+            lambda: _counter("wire_conn_errors") == errors + 1)
+
+
+class _TearServer:
+    """Accepts, reads ONE frame, counts it, closes without replying —
+    the deterministic torn-response peer for resend-semantics tests."""
+
+    def __init__(self):
+        self._lsock = socket.socket()
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(8)
+        self.host, self.port = self._lsock.getsockname()
+        self.frames = 0
+        self._run = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while self._run:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(2.0)
+                hdr = b""
+                while len(hdr) < 4:
+                    chunk = conn.recv(4 - len(hdr))
+                    if not chunk:
+                        raise OSError
+                    hdr += chunk
+                (n,) = struct.unpack("<I", hdr)
+                got = 0
+                while got < n:
+                    chunk = conn.recv(n - got)
+                    if not chunk:
+                        raise OSError
+                    got += len(chunk)
+                self.frames += 1
+            except OSError:
+                pass
+            finally:
+                conn.close()                    # never answer
+
+    def stop(self):
+        self._run = False
+        self._lsock.close()
+
+
+class TestClientRetrySemantics:
+    def test_idempotent_call_resent_after_reconnect(self):
+        tear = _TearServer()
+        try:
+            c = ValidatorRpcClient(
+                tear.host, tear.port, types=SimpleNamespace(),
+                timeout=5.0, reconnect_attempts=2,
+                backoff_base_s=0.01, breaker_trip_after=10)
+            reconnects = _counter("wire_client_reconnects")
+            with pytest.raises((ConnectionError, OSError)):
+                c.call_raw("GetHealth")         # idempotent
+            # original + 2 reconnect attempts all reached the peer
+            assert tear.frames == 3
+            assert (_counter("wire_client_reconnects")
+                    == reconnects + 2)
+            c.close()
+        finally:
+            tear.stop()
+
+    def test_mutating_call_never_resent(self):
+        tear = _TearServer()
+        try:
+            c = ValidatorRpcClient(
+                tear.host, tear.port, types=SimpleNamespace(),
+                timeout=5.0, reconnect_attempts=2,
+                backoff_base_s=0.01, breaker_trip_after=10)
+            with pytest.raises((ConnectionError, OSError)):
+                c.call_raw("ProposeBlock")      # mutating
+            assert tear.frames == 1             # exactly ONE attempt
+            c.close()
+        finally:
+            tear.stop()
+
+    def test_reconnect_across_server_restart(self, server):
+        c = _client(server, reconnect_attempts=3,
+                    breaker_trip_after=10)
+        c.call_raw("Echo")
+        port = server.port
+        server.stop(drain_s=0.5)
+        srv2 = ValidatorRpcServer(
+            SimpleNamespace(), port=port, read_deadline_s=0.5)
+        srv2.calls = 0
+
+        def echo2(payload):
+            srv2.calls += 1
+            return pb.Empty()
+
+        srv2.handlers.table["GetHealth"] = echo2
+        srv2.start()
+        try:
+            c.call_raw("GetHealth")             # idempotent: resends
+            assert srv2.calls == 1
+        finally:
+            c.close()
+            srv2.stop(drain_s=0.5)
+
+    def test_breaker_fails_fast_on_dead_server(self):
+        # grab a port that is closed
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        host, port = probe.getsockname()
+        probe.close()
+        trips = _counter("wire_client_breaker_trips")
+        c = ValidatorRpcClient(
+            host, port, types=SimpleNamespace(), timeout=0.5,
+            reconnect_attempts=0, breaker_trip_after=2,
+            breaker_cooldown_s=0.2)
+        for _ in range(2):
+            with pytest.raises((ConnectionError, OSError)):
+                c.call_raw("ProposeBlock")
+        assert (_counter("wire_client_breaker_trips") == trips + 1)
+        # breaker now open: instant typed failure with a retry hint
+        t0 = time.monotonic()
+        with pytest.raises(RpcError) as ei:
+            c.call_raw("ProposeBlock")
+        assert time.monotonic() - t0 < 0.1      # no connect attempt
+        assert ei.value.code == UNAVAILABLE
+        assert retry_after_from(str(ei.value)) is not None
+        # cooldown elapses: the next call is a real probe again
+        time.sleep(0.25)
+        with pytest.raises((ConnectionError, OSError)):
+            c.call_raw("ProposeBlock")
+        c.close()
+
+
+class TestValidatorSubmitMatrix:
+    """Satellite: ``ValidatorClient._submit`` retry semantics over a
+    REAL socket — admission rejections retried with the hint,
+    transport errors on mutating calls never resent."""
+
+    def _vc(self, rpc_client):
+        from prysm_tpu.validator.client import ValidatorClient
+
+        return ValidatorClient(rpc_client, SimpleNamespace(),
+                               types=SimpleNamespace(),
+                               submit_retries=3,
+                               submit_deadline_s=5.0)
+
+    def test_admission_rejection_honored_then_succeeds(self, server):
+        state = {"n": 0}
+
+        def flaky(payload):
+            state["n"] += 1
+            if state["n"] == 1:
+                raise AdmissionRejected("credits", 0.01)
+            return pb.Empty()
+
+        server.handlers.table["FlakySubmit"] = flaky
+        c = _client(server)
+        vc = self._vc(c)
+        vc._submit(c.call_raw, "FlakySubmit")
+        assert state["n"] == 2                  # rejected then resent
+        assert vc.submit_retries_used == 1
+        assert vc.submits_dropped == 0
+        c.close()
+
+    def test_transport_error_on_mutating_submit_not_resent(self):
+        tear = _TearServer()
+        try:
+            c = ValidatorRpcClient(
+                tear.host, tear.port, types=SimpleNamespace(),
+                timeout=5.0, reconnect_attempts=2,
+                backoff_base_s=0.01, breaker_trip_after=10)
+            vc = self._vc(c)
+            with pytest.raises((ConnectionError, OSError)):
+                vc._submit(c.call_raw, "ProposeAttestation")
+            assert tear.frames == 1             # never resent
+            assert vc.submit_retries_used == 0
+            c.close()
+        finally:
+            tear.stop()
+
+    def test_breaker_unavailable_waited_out_and_resent(self, server):
+        server.handlers.table["Sub"] = lambda p: pb.Empty()
+        c = _client(server)
+        vc = self._vc(c)
+        # trip the gate artificially: open for 50ms
+        c._open_until = time.monotonic() + 0.05
+        vc._submit(c.call_raw, "Sub")           # UNAVAILABLE -> retry
+        assert vc.submit_retries_used == 1
+        assert vc.submits_dropped == 0
+        c.close()
+
+
+class TestHTTPWire:
+    def _srv(self, **kw):
+        kw.setdefault("read_deadline_s", 0.3)
+        kw.setdefault("max_connections", 4)
+        kw.setdefault("drain_deadline_s", 1.0)
+        srv = BeaconHTTPServer(SimpleNamespace(), SimpleNamespace(),
+                               **kw)
+        srv.start()
+        return srv
+
+    def test_http_slowloris_reaped(self):
+        srv = self._srv()
+        try:
+            reaps = _counter("wire_reaps")
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5.0)
+            s.sendall(b"GET /eth/v1/nod")       # partial request line
+            assert _dead(s, 2.0)
+            assert _counter("wire_reaps") == reaps + 1
+            s.close()
+        finally:
+            srv.stop(drain_s=0.5)
+
+    def test_http_over_cap_refused_503(self):
+        srv = self._srv(max_connections=1, read_deadline_s=2.0)
+        try:
+            refusals = _counter("wire_accept_refusals")
+            hold = socket.create_connection(("127.0.0.1", srv.port),
+                                            timeout=5.0)
+            time.sleep(0.05)                    # let it register
+            extra = socket.create_connection(("127.0.0.1", srv.port),
+                                             timeout=5.0)
+            extra.settimeout(3.0)
+            data = b""
+            try:
+                while True:
+                    chunk = extra.recv(4096)
+                    if not chunk:
+                        break
+                    data += chunk
+            except OSError:
+                pass
+            assert b"503" in data
+            assert b"Retry-After" in data
+            assert b"retry_after_s" in data
+            assert (_counter("wire_accept_refusals") == refusals + 1)
+            hold.close()
+            extra.close()
+        finally:
+            srv.stop(drain_s=0.5)
+
+    def test_http_extra_route_served(self):
+        srv = self._srv()
+        try:
+            def route(h, body):
+                h._send(200, {"echo": body["x"]})
+
+            srv.extra_routes["/wire/echo"] = route
+            import http.client
+            import json as _json
+
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=5.0)
+            conn.request("POST", "/wire/echo",
+                         _json.dumps({"x": 41}),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 200
+            assert _json.loads(r.read()) == {"echo": 41}
+            conn.close()
+        finally:
+            srv.stop(drain_s=0.5)
+
+
+class TestScenarioGenerators:
+    def test_flapping_client_absorbed(self, server):
+        flap = FlappingClient(server.host, server.port, cycles=6,
+                              seed=3)
+        stats = flap.run()
+        assert stats["cycles"] == 6
+        assert stats["aborts"] + stats["refused"] == 6
+        # the server absorbed the churn and still serves
+        assert _wait_for(lambda: server.tracker.active() == 0)
+        c = _client(server)
+        c.call_raw("Echo")
+        assert server.calls["Echo"] == 1
+        c.close()
+
+
+class TestSocketsStorm:
+    def test_sockets_storm_smoke(self):
+        """The sockets-mode multi-tenant storm, shrunk: real framed
+        gRPC + HTTP carriers, chaos window with wire faults, a
+        slowloris swarm and a flapping client live — the ledger must
+        balance across the lossy wire with zero lost submissions and
+        a clean drain."""
+        from prysm_tpu.runtime.scenarios import run_multitenant
+
+        r = run_multitenant(
+            n_sessions=64, n_validators=2048, n_steps=6, per_step=24,
+            warmup=2, storm_start=2, storm_len=2, seed=99,
+            sockets=True, n_clients=6, max_connections=24,
+            read_deadline_s=0.6, loris=3, flap_cycles=6,
+            wire_chaos_rate=0.05)
+        assert r["mode"] == "sockets"
+        assert r["accounting_ok"], r
+        assert r["lost"] == 0, r
+        assert r["shed_accounting_ok"], r
+        assert not r["divergences"], r["divergences"]
+        assert r["fail_closed_abandons"] == 0, r
+        wire = r["wire"]
+        assert wire["max_active_connections"] <= wire["connection_cap"]
+        assert wire["loris_reaped"] is True
+        assert wire["drain_fail_closed"] == 0
+        assert wire["connections_opened"] == wire["connections_closed"]
+        assert wire["tcp_submissions"] > 0
+        assert wire["http_submissions"] > 0
+
+    @pytest.mark.slow
+    def test_sockets_storm_10k_sessions(self):
+        """The full acceptance shape: >=10k sessions through the real
+        socket path under live wire chaos (the bench tier runs the
+        full step count; this keeps the session floor)."""
+        from prysm_tpu.runtime.scenarios import run_multitenant_sockets
+
+        r = run_multitenant_sockets(
+            n_sessions=10_000, n_validators=50_000, n_steps=44,
+            per_step=256, warmup=4, storm_start=8, storm_len=4,
+            seed=1337)
+        assert r["sessions"] >= 10_000
+        assert r["sessions_submitting"] >= 10_000
+        assert r["accounting_ok"], r
+        assert r["lost"] == 0, r
+        assert r["fail_closed_abandons"] == 0, r
+        wire = r["wire"]
+        assert wire["max_active_connections"] <= wire["connection_cap"]
+        assert wire["loris_reaped"] is True
+        assert wire["drain_fail_closed"] == 0
